@@ -1,0 +1,39 @@
+"""Task status state machine (reference pkg/scheduler/api/types.go:26-84)."""
+
+from __future__ import annotations
+
+from enum import IntEnum
+
+
+class TaskStatus(IntEnum):
+    """10-state task lifecycle (reference types.go:26-58). IntEnum so the
+    status doubles as the tensor encoding on the XLA path."""
+
+    PENDING = 0      # waiting in queue
+    ALLOCATED = 1    # resources assigned, not dispatched (gang barrier holds it)
+    PIPELINED = 2    # assigned onto releasing resources; dispatch when freed
+    BINDING = 3      # bind RPC in flight
+    BOUND = 4        # bound to host, kubelet not started it yet
+    RUNNING = 5
+    RELEASING = 6    # being deleted / preempted
+    SUCCEEDED = 7
+    FAILED = 8
+    UNKNOWN = 9
+
+    def __str__(self) -> str:  # "Pending" etc., matching reference labels
+        return self.name.capitalize()
+
+
+# Statuses that count as "holding resources" (reference helpers.go:64-71).
+ALLOCATED_STATUSES = frozenset(
+    {TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING, TaskStatus.ALLOCATED}
+)
+
+
+def allocated_status(status: TaskStatus) -> bool:
+    return status in ALLOCATED_STATUSES
+
+
+def validate_status_update(old: TaskStatus, new: TaskStatus) -> None:
+    """All transitions permitted (reference types.go:82-84)."""
+    return None

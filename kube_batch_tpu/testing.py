@@ -1,0 +1,214 @@
+"""Builders shared by unit tests, action tests, and the bench harness
+(reference pkg/scheduler/api/test_utils.go and pkg/scheduler/util/test_utils.go).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Optional, Union
+
+from kube_batch_tpu.apis.types import (
+    GROUP_NAME_ANNOTATION_KEY,
+    Container,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    Queue,
+    QueueSpec,
+)
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.api.resource_info import Resource
+
+_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,  # milli (cpu)
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+}
+
+
+def parse_quantity(q: Union[str, int, float]) -> float:
+    """Parse a Kubernetes-style quantity string ("100m", "1G", "2Gi") into a
+    float in base units (cores for cpu, bytes for memory)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    m = _QUANTITY_RE.match(q.strip())
+    if not m:
+        raise ValueError(f"cannot parse quantity {q!r}")
+    value, suffix = m.groups()
+    if suffix not in _SUFFIX:
+        raise ValueError(f"unknown quantity suffix {suffix!r} in {q!r}")
+    return float(value) * _SUFFIX[suffix]
+
+
+def build_resource_list(
+    cpu: Union[str, float] = 0,
+    memory: Union[str, float] = 0,
+    pods: int = 0,
+    **scalars: Union[str, float],
+) -> dict[str, float]:
+    """Resource list dict from k8s-style quantity strings. Scalar kwargs use
+    double-underscore for '/' and '.' (e.g. nvidia__com__gpu=2) or pass a
+    pre-built dict via build_resource_list(**{"nvidia.com/gpu": 2})."""
+    rl: dict[str, float] = {}
+    if cpu:
+        rl["cpu"] = parse_quantity(cpu)
+    if memory:
+        rl["memory"] = parse_quantity(memory)
+    if pods:
+        rl["pods"] = float(pods)
+    for name, q in scalars.items():
+        rl[name] = parse_quantity(q)
+    return rl
+
+
+def build_pod(
+    namespace: str = "default",
+    name: str = "pod",
+    node_name: str = "",
+    phase: PodPhase = PodPhase.PENDING,
+    req: Optional[dict[str, float]] = None,
+    group_name: str = "",
+    labels: Optional[dict[str, str]] = None,
+    priority: Optional[int] = None,
+    node_selector: Optional[dict[str, str]] = None,
+    scheduler_name: str = "kube-batch-tpu",
+) -> Pod:
+    """reference api/test_utils.go buildPod."""
+    annotations = {}
+    if group_name:
+        annotations[GROUP_NAME_ANNOTATION_KEY] = group_name
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=f"{namespace}-{name}",
+            labels=labels or {},
+            annotations=annotations,
+        ),
+        phase=phase,
+        containers=[Container(requests=dict(req or {}))],
+        node_name=node_name,
+        node_selector=node_selector or {},
+        priority=priority,
+        scheduler_name=scheduler_name,
+    )
+
+
+def build_node(
+    name: str,
+    alloc: Optional[dict[str, float]] = None,
+    labels: Optional[dict[str, str]] = None,
+    capacity: Optional[dict[str, float]] = None,
+) -> Node:
+    """reference api/test_utils.go buildNode."""
+    alloc = dict(alloc or {})
+    return Node(
+        metadata=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        allocatable=alloc,
+        capacity=dict(capacity) if capacity is not None else dict(alloc),
+    )
+
+
+def build_pod_group(
+    name: str,
+    namespace: str = "default",
+    queue: str = "default",
+    min_member: int = 1,
+    min_resources: Optional[dict[str, float]] = None,
+) -> PodGroup:
+    return PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=f"pg-{namespace}-{name}"),
+        spec=PodGroupSpec(min_member=min_member, queue=queue, min_resources=min_resources),
+    )
+
+
+def build_queue(name: str, weight: int = 1) -> Queue:
+    return Queue(metadata=ObjectMeta(name=name, uid=f"q-{name}"), spec=QueueSpec(weight=weight))
+
+
+def build_task(
+    namespace: str = "default",
+    name: str = "task",
+    req: Optional[dict[str, float]] = None,
+    node_name: str = "",
+    phase: PodPhase = PodPhase.PENDING,
+    group_name: str = "",
+    priority: Optional[int] = None,
+) -> TaskInfo:
+    return TaskInfo(
+        build_pod(
+            namespace=namespace,
+            name=name,
+            node_name=node_name,
+            phase=phase,
+            req=req,
+            group_name=group_name,
+            priority=priority,
+        )
+    )
+
+
+def build_resource(cpu: Union[str, float] = 0, memory: Union[str, float] = 0, **scalars) -> Resource:
+    return Resource.from_resource_list(build_resource_list(cpu, memory, **scalars))
+
+
+class FakeBinder:
+    """Records binds instead of calling an API server; signals a condition
+    per bind (reference util/test_utils.go:95-117)."""
+
+    def __init__(self) -> None:
+        self.binds: dict[str, str] = {}  # "ns/name" -> node
+        self.channel: "threading.Event" = threading.Event()
+        self._lock = threading.Lock()
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        with self._lock:
+            self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        self.channel.set()
+
+
+class FakeEvictor:
+    """reference util/test_utils.go:120-140."""
+
+    def __init__(self) -> None:
+        self.evicts: list[str] = []
+        self.channel: "threading.Event" = threading.Event()
+        self._lock = threading.Lock()
+
+    def evict(self, pod: Pod) -> None:
+        with self._lock:
+            self.evicts.append(f"{pod.namespace}/{pod.name}")
+        self.channel.set()
+
+
+class FakeStatusUpdater:
+    """no-op (reference util/test_utils.go:143-153)."""
+
+    def update_pod_condition(self, pod: Pod, condition) -> None:
+        return None
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        return None
+
+
+class FakeVolumeBinder:
+    """no-op (reference util/test_utils.go:156-166)."""
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        return None
+
+    def bind_volumes(self, task) -> None:
+        return None

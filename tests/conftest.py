@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding tests run anywhere (the driver separately dry-runs the multichip
+path). Must run before the first ``import jax`` anywhere in the test
+process."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

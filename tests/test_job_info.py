@@ -1,0 +1,137 @@
+"""TaskInfo/JobInfo bookkeeping invariants
+(reference pkg/scheduler/api/job_info_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.api import JobInfo, Resource, TaskStatus
+from kube_batch_tpu.api.job_info import get_job_id
+from kube_batch_tpu.apis.types import PodPhase
+from kube_batch_tpu.testing import build_pod, build_resource_list, build_task
+
+
+def rl(cpu, mem):
+    return build_resource_list(cpu, mem)
+
+
+class TestTaskInfo:
+    def test_new_task_from_pending_pod(self):
+        t = build_task(name="p1", req=rl("1", "1G"))
+        assert t.status == TaskStatus.PENDING
+        assert t.resreq == Resource.from_resource_list(rl("1", "1G"))
+        assert t.priority == 1  # default (job_info.go:80)
+
+    def test_status_from_phase_and_node(self):
+        assert build_task(phase=PodPhase.RUNNING, node_name="n1").status == TaskStatus.RUNNING
+        assert build_task(phase=PodPhase.PENDING, node_name="n1").status == TaskStatus.BOUND
+        assert build_task(phase=PodPhase.SUCCEEDED).status == TaskStatus.SUCCEEDED
+        assert build_task(phase=PodPhase.FAILED).status == TaskStatus.FAILED
+
+    def test_releasing_when_deleting(self):
+        pod = build_pod(name="doomed", phase=PodPhase.RUNNING, node_name="n1")
+        pod.metadata.deletion_timestamp = 123.0
+        from kube_batch_tpu.api.job_info import TaskInfo
+
+        assert TaskInfo(pod).status == TaskStatus.RELEASING
+
+    def test_job_id_from_annotation(self):
+        pod = build_pod(namespace="ns", name="p", group_name="pg1")
+        assert get_job_id(pod) == "ns/pg1"
+        assert get_job_id(build_pod(name="orphan")) == ""
+
+    def test_clone_isolates_resources(self):
+        t = build_task(req=rl("1", "1G"))
+        c = t.clone()
+        c.resreq.add(Resource(milli_cpu=1))
+        assert t.resreq != c.resreq
+
+
+class TestJobInfo:
+    def test_add_task_updates_aggregates(self):
+        """reference job_info_test.go TestAddTaskInfo."""
+        job = JobInfo("ns/j1")
+        t1 = build_task(name="p1", req=rl("1", "1G"), group_name="j1")
+        t2 = build_task(name="p2", req=rl("2", "2G"), group_name="j1", node_name="n1",
+                        phase=PodPhase.RUNNING)
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+
+        assert len(job.tasks) == 2
+        assert job.total_request == Resource.from_resource_list(rl("3", "3G"))
+        # only the running task is allocated
+        assert job.allocated == Resource.from_resource_list(rl("2", "2G"))
+        assert set(job.task_status_index) == {TaskStatus.PENDING, TaskStatus.RUNNING}
+
+    def test_delete_task_restores_aggregates(self):
+        """reference job_info_test.go TestDeleteTaskInfo."""
+        job = JobInfo("ns/j1")
+        t1 = build_task(name="p1", req=rl("1", "1G"))
+        t2 = build_task(name="p2", req=rl("2", "2G"), node_name="n1", phase=PodPhase.RUNNING)
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+        job.delete_task_info(t2)
+
+        assert len(job.tasks) == 1
+        assert job.total_request == Resource.from_resource_list(rl("1", "1G"))
+        assert job.allocated.is_empty()
+        assert TaskStatus.RUNNING not in job.task_status_index
+
+    def test_delete_missing_raises(self):
+        job = JobInfo("ns/j1")
+        with pytest.raises(KeyError):
+            job.delete_task_info(build_task(name="ghost"))
+
+    def test_update_task_status_moves_index(self):
+        job = JobInfo("ns/j1")
+        t = build_task(name="p1", req=rl("1", "1G"))
+        job.add_task_info(t)
+        job.update_task_status(t, TaskStatus.ALLOCATED)
+        assert TaskStatus.PENDING not in job.task_status_index
+        assert t.uid in job.task_status_index[TaskStatus.ALLOCATED]
+        assert job.allocated == Resource.from_resource_list(rl("1", "1G"))
+
+    def test_gang_predicates(self):
+        job = JobInfo("ns/j1")
+        job.min_available = 2
+        t1 = build_task(name="p1", req=rl("1", "1G"))
+        t2 = build_task(name="p2", req=rl("1", "1G"))
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+
+        assert job.valid_task_num() == 2
+        assert job.ready_task_num() == 0
+        assert not job.ready()
+
+        job.update_task_status(t1, TaskStatus.ALLOCATED)
+        assert job.ready_task_num() == 1
+        assert not job.ready()
+        job.update_task_status(t2, TaskStatus.PIPELINED)
+        assert job.waiting_task_num() == 1
+        assert job.pipelined()  # ready + waiting >= min
+        assert not job.ready()
+
+        job.update_task_status(t2, TaskStatus.BOUND)
+        assert job.ready()
+
+    def test_fit_error_histogram(self):
+        job = JobInfo("ns/j1")
+        job.nodes_fit_delta = {
+            "n1": Resource(milli_cpu=-10),
+            "n2": Resource(milli_cpu=-10, memory=-1),
+        }
+        msg = job.fit_error()
+        assert "0/2 nodes are available" in msg
+        assert "2 insufficient cpu" in msg
+        assert "1 insufficient memory" in msg
+        assert JobInfo("ns/empty").fit_error() == "0 nodes are available"
+
+    def test_clone(self):
+        job = JobInfo("ns/j1")
+        job.min_available = 2
+        job.queue = "q1"
+        job.add_task_info(build_task(name="p1", req=rl("1", "1G")))
+        c = job.clone()
+        assert c.uid == job.uid and c.queue == "q1" and c.min_available == 2
+        assert len(c.tasks) == 1
+        # mutating the clone must not affect the original
+        c.update_task_status(next(iter(c.tasks.values())), TaskStatus.ALLOCATED)
+        assert job.ready_task_num() == 0

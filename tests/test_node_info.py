@@ -1,0 +1,113 @@
+"""NodeInfo accounting invariants (reference pkg/scheduler/api/node_info_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.api import NodeInfo, Resource, TaskStatus
+from kube_batch_tpu.apis.types import PodPhase
+from kube_batch_tpu.testing import build_node, build_resource_list, build_task
+
+
+def rl(cpu, mem):
+    return build_resource_list(cpu, mem)
+
+
+def make_node(cpu="8", mem="8G"):
+    return NodeInfo(build_node("n1", rl(cpu, mem)))
+
+
+class TestAddRemove:
+    def test_add_task_consumes_idle(self):
+        """reference node_info_test.go TestNodeInfo_AddPod."""
+        ni = make_node()
+        ni.add_task(build_task(name="p1", req=rl("1", "1G"), node_name="n1",
+                               phase=PodPhase.RUNNING))
+        ni.add_task(build_task(name="p2", req=rl("2", "2G"), node_name="n1",
+                               phase=PodPhase.RUNNING))
+        assert ni.idle == Resource.from_resource_list(rl("5", "5G"))
+        assert ni.used == Resource.from_resource_list(rl("3", "3G"))
+        assert len(ni.tasks) == 2
+
+    def test_remove_task_restores_idle(self):
+        """reference node_info_test.go TestNodeInfo_RemovePod."""
+        ni = make_node()
+        t1 = build_task(name="p1", req=rl("1", "1G"), node_name="n1", phase=PodPhase.RUNNING)
+        t2 = build_task(name="p2", req=rl("2", "2G"), node_name="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t1)
+        ni.add_task(t2)
+        ni.remove_task(t1)
+        assert ni.idle == Resource.from_resource_list(rl("6", "6G"))
+        assert ni.used == Resource.from_resource_list(rl("2", "2G"))
+
+    def test_add_duplicate_raises(self):
+        ni = make_node()
+        t = build_task(name="p1", req=rl("1", "1G"), node_name="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        with pytest.raises(KeyError):
+            ni.add_task(t)
+
+    def test_remove_missing_raises(self):
+        with pytest.raises(KeyError):
+            make_node().remove_task(build_task(name="ghost", node_name="n1"))
+
+
+class TestStatusAccounting:
+    def test_releasing_task(self):
+        """Releasing consumes idle AND is tracked in releasing
+        (node_info.go:120-123)."""
+        ni = make_node()
+        t = build_task(name="p1", req=rl("2", "2G"), node_name="n1", phase=PodPhase.RUNNING)
+        t.status = TaskStatus.RELEASING
+        ni.add_task(t)
+        assert ni.idle == Resource.from_resource_list(rl("6", "6G"))
+        assert ni.releasing == Resource.from_resource_list(rl("2", "2G"))
+        assert ni.used == Resource.from_resource_list(rl("2", "2G"))
+        ni.remove_task(t)
+        assert ni.idle == Resource.from_resource_list(rl("8", "8G"))
+        assert ni.releasing.is_empty()
+
+    def test_pipelined_task_rides_releasing(self):
+        """Pipelined subtracts from releasing, not idle (node_info.go:124-125)."""
+        ni = make_node()
+        rel = build_task(name="victim", req=rl("2", "2G"), node_name="n1",
+                         phase=PodPhase.RUNNING)
+        rel.status = TaskStatus.RELEASING
+        ni.add_task(rel)
+        pipe = build_task(name="incoming", req=rl("2", "2G"), node_name="n1")
+        pipe.status = TaskStatus.PIPELINED
+        ni.add_task(pipe)
+        assert ni.releasing.is_empty()  # 2G releasing - 2G pipelined
+        assert ni.idle == Resource.from_resource_list(rl("6", "6G"))
+
+    def test_task_clone_isolation(self):
+        """Node holds a clone: caller status flips don't corrupt accounting
+        (node_info.go:117)."""
+        ni = make_node()
+        t = build_task(name="p1", req=rl("1", "1G"), node_name="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        t.status = TaskStatus.RELEASING  # mutate caller's copy
+        ni.remove_task(t)  # looked up by key; node's clone still RUNNING
+        assert ni.idle == Resource.from_resource_list(rl("8", "8G"))
+        assert ni.releasing.is_empty()
+
+
+class TestSetNodeClone:
+    def test_set_node_recomputes(self):
+        """reference node_info_test.go TestNodeInfo_SetNode."""
+        ni = make_node("4", "4G")
+        ni.add_task(build_task(name="p1", req=rl("1", "1G"), node_name="n1",
+                               phase=PodPhase.RUNNING))
+        bigger = build_node("n1", rl("16", "16G"))
+        ni.set_node(bigger)
+        assert ni.allocatable == Resource.from_resource_list(rl("16", "16G"))
+        assert ni.idle == Resource.from_resource_list(rl("15", "15G"))
+        assert ni.used == Resource.from_resource_list(rl("1", "1G"))
+
+    def test_clone(self):
+        ni = make_node()
+        ni.add_task(build_task(name="p1", req=rl("1", "1G"), node_name="n1",
+                               phase=PodPhase.RUNNING))
+        c = ni.clone()
+        assert c.idle == ni.idle and c.used == ni.used and len(c.tasks) == 1
+        c.add_task(build_task(name="p2", req=rl("1", "1G"), node_name="n1",
+                              phase=PodPhase.RUNNING))
+        assert len(ni.tasks) == 1  # original untouched

@@ -1,0 +1,161 @@
+"""Resource arithmetic invariants — port of the reference table tests
+(reference pkg/scheduler/api/resource_info_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.api import Resource
+from kube_batch_tpu.api.resource_info import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+)
+
+
+def res(mcpu=0.0, mem=0.0, scalars=None):
+    return Resource(milli_cpu=mcpu, memory=mem, scalars=scalars)
+
+
+class TestConstruction:
+    def test_from_resource_list_converts_units(self):
+        r = Resource.from_resource_list(
+            {"cpu": 2, "memory": 3 * 2**30, "pods": 10, "nvidia.com/gpu": 1}
+        )
+        assert r.milli_cpu == 2000
+        assert r.memory == 3 * 2**30
+        assert r.max_task_num == 10
+        assert r.scalars["nvidia.com/gpu"] == 1000
+
+    def test_empty_and_none(self):
+        assert Resource.from_resource_list(None) == Resource.empty()
+        assert Resource.from_resource_list({}).is_empty()
+
+    def test_clone_is_deep(self):
+        r = res(1000, 100, {"nvidia.com/gpu": 1000})
+        c = r.clone()
+        c.add(res(1, 1, {"nvidia.com/gpu": 1}))
+        assert r == res(1000, 100, {"nvidia.com/gpu": 1000})
+        assert c != r
+
+
+class TestPredicates:
+    # reference resource_info_test.go IsEmpty cases
+    @pytest.mark.parametrize(
+        "r,expected",
+        [
+            (res(), True),
+            (res(MIN_MILLI_CPU - 1, MIN_MEMORY - 1), True),
+            (res(MIN_MILLI_CPU, 0), False),
+            (res(0, MIN_MEMORY), False),
+            (res(0, 0, {"nvidia.com/gpu": MIN_MILLI_SCALAR}), False),
+            (res(0, 0, {"nvidia.com/gpu": MIN_MILLI_SCALAR - 1}), True),
+        ],
+    )
+    def test_is_empty(self, r, expected):
+        assert r.is_empty() is expected
+
+    def test_is_zero(self):
+        r = res(5, 5, {"nvidia.com/gpu": 5})
+        assert r.is_zero("cpu")
+        assert r.is_zero("memory")
+        assert r.is_zero("nvidia.com/gpu")
+        with pytest.raises(KeyError):
+            r.is_zero("google.com/tpu")
+        assert Resource.empty().is_zero("whatever")  # no scalars at all -> zero
+
+    @pytest.mark.parametrize(
+        "l,r,expected",
+        [
+            (res(100, 100), res(200, 200), True),
+            (res(100, 100), res(100, 200), False),  # not strictly less on cpu
+            (res(100, 100, {"g": 1}), res(200, 200, {"g": 2}), True),
+            (res(100, 100, {"g": 2}), res(200, 200, {"g": 2}), False),
+            (res(100, 100, {"g": 1}), res(200, 200), False),  # scalar missing on r
+        ],
+    )
+    def test_less(self, l, r, expected):
+        assert l.less(r) is expected
+
+    @pytest.mark.parametrize(
+        "l,r,expected",
+        [
+            (res(100, 100), res(100, 100), True),  # equal within epsilon
+            (res(100 + MIN_MILLI_CPU - 1, 100), res(100, 100), True),
+            (res(100 + MIN_MILLI_CPU, 100), res(100, 100), False),
+            (res(0, 100 + MIN_MEMORY), res(0, 100), False),
+            (res(0, 0, {"g": 5}), res(0, 0), True),  # scalar within epsilon of 0
+            (res(0, 0, {"g": MIN_MILLI_SCALAR}), res(0, 0), False),
+        ],
+    )
+    def test_less_equal_epsilon(self, l, r, expected):
+        assert l.less_equal(r) is expected
+
+
+class TestArithmetic:
+    def test_add(self):
+        r = res(100, 100, {"g": 1}).add(res(50, 50, {"g": 1, "t": 2}))
+        assert r == res(150, 150, {"g": 2, "t": 2})
+
+    def test_sub(self):
+        r = res(100, 100, {"g": 2}).sub(res(50, 50, {"g": 1}))
+        assert r == res(50, 50, {"g": 1})
+
+    def test_sub_underflow_raises(self):
+        with pytest.raises(ValueError):
+            res(100, 100).sub(res(200, 100))
+
+    def test_sub_within_epsilon_allowed(self):
+        # LessEqual is epsilon-tolerant, so sub can leave tiny negatives.
+        r = res(100, 100).sub(res(100 + MIN_MILLI_CPU / 2, 100))
+        assert r.milli_cpu == pytest.approx(-MIN_MILLI_CPU / 2)
+
+    def test_set_max_resource(self):
+        r = res(100, 300, {"g": 1})
+        r.set_max_resource(res(200, 200, {"g": 0.5, "t": 4}))
+        assert r == res(200, 300, {"g": 1, "t": 4})
+
+    def test_fit_delta_epsilon_margin(self):
+        r = res(100, 100).fit_delta(res(100, 0))
+        assert r.milli_cpu == -MIN_MILLI_CPU  # 100 - (100 + eps)
+        assert r.memory == 100  # memory not requested -> untouched
+
+    def test_fit_delta_scalar(self):
+        r = res(0, 0, {"g": 500}).fit_delta(res(0, 0, {"g": 1000}))
+        assert r.scalars["g"] == 500 - 1000 - MIN_MILLI_SCALAR
+
+    def test_multi(self):
+        assert res(100, 100, {"g": 3}).multi(2) == res(200, 200, {"g": 6})
+
+    def test_max_task_num_excluded_from_arithmetic(self):
+        a = Resource.from_resource_list({"pods": 10, "cpu": 1})
+        b = Resource.from_resource_list({"pods": 20, "cpu": 1})
+        a.add(b)
+        assert a.max_task_num == 10  # untouched by Add (resource_info.go:38-39)
+
+
+class TestAccess:
+    def test_get(self):
+        r = res(100, 200, {"g": 3})
+        assert r.get("cpu") == 100
+        assert r.get("memory") == 200
+        assert r.get("g") == 3
+        assert r.get("missing") == 0
+
+    def test_resource_names(self):
+        assert res(0, 0, {"g": 1}).resource_names() == ["cpu", "memory", "g"]
+
+
+class TestVectorInterface:
+    def test_roundtrip(self):
+        r = res(1500, 2**30, {"nvidia.com/gpu": 2000})
+        names = ["nvidia.com/gpu", "google.com/tpu"]
+        vec = r.to_vector(names)
+        assert vec == [1500, 2**30, 2000, 0.0]
+        assert Resource.from_vector(vec, names) == r
+
+    def test_epsilons_align(self):
+        names = ["nvidia.com/gpu"]
+        assert Resource.vector_epsilons(names) == [
+            MIN_MILLI_CPU,
+            MIN_MEMORY,
+            MIN_MILLI_SCALAR,
+        ]
