@@ -1,0 +1,24 @@
+"""Action registration (reference pkg/scheduler/actions/factory.go:29-35)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.framework.registry import register_action
+
+
+def register_all_actions() -> None:
+    from kube_batch_tpu.actions import allocate, backfill, enqueue, preempt, reclaim
+
+    register_action(enqueue.new())
+    register_action(allocate.new())
+    register_action(backfill.new())
+    register_action(preempt.new())
+    register_action(reclaim.new())
+
+    # The vectorized TPU path registers lazily: importing jax is deferred
+    # until something asks for the action by name.
+    try:
+        from kube_batch_tpu.actions import xla_allocate
+
+        register_action(xla_allocate.new())
+    except ImportError:
+        pass

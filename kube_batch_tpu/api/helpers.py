@@ -35,13 +35,19 @@ def merge_errors(*errs: Optional[Exception]) -> Optional[Exception]:
 
 
 def min_resource(l: Resource, r: Resource) -> Resource:
-    """Elementwise min over cpu/memory (reference api/helpers/helpers.go:28-37)."""
+    """Elementwise min (reference api/helpers/helpers.go:28-44). Go nil-map
+    parity ({} == nil, see resource_info module docstring): when either
+    side has no scalars the result has none — zero-filled entries would
+    flip later nil-sensitive less/less_equal policy checks (e.g.
+    proportion's overused gate)."""
     out = Resource(
         milli_cpu=min(l.milli_cpu, r.milli_cpu),
         memory=min(l.memory, r.memory),
     )
-    for name in set(l.scalars) | set(r.scalars):
-        out.scalars[name] = min(l.scalars.get(name, 0.0), r.scalars.get(name, 0.0))
+    if not l.scalars or not r.scalars:
+        return out
+    for name, q in l.scalars.items():
+        out.scalars[name] = min(q, r.scalars.get(name, 0.0))
     return out
 
 

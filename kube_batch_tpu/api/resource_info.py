@@ -10,10 +10,16 @@ This struct is also the contract for the TPU path: ``to_vector`` /
 task x resource and node x resource tensors built by
 kube_batch_tpu.ops.encode (SURVEY.md section 7 step 1).
 
-Deviation from the reference (documented, intentional): Go distinguishes a
-nil ScalarResources map from an empty one in ``Less``/``LessEqual``; that is
-an implementation artifact with no policy meaning, so here a missing scalar
-key simply reads as 0.
+Nil-map parity (round-2 decision, tested in tests/test_resource_info.py):
+Go distinguishes a nil ScalarResources map from an empty one, and that
+distinction *does* gate policy — ``Less`` returns False when both maps are
+nil even if cpu/memory are strictly less (resource_info.go:234-239), and
+``Less`` guards preempt's validateVictims (preempt.go:268), reclaim
+(reclaim.go:156) and enqueue's overcommit brake (enqueue.go:88). In Go a
+scalar map is nil iff no scalar was ever added (NewResource/AddScalar
+initialize lazily), so an empty Python dict maps exactly onto a nil Go
+map: ``{} == nil``. less/less_equal/sub below implement the Go branches
+under that identification, bit-for-bit.
 """
 
 from __future__ import annotations
@@ -33,6 +39,13 @@ MIN_MEMORY = 10.0 * 1024 * 1024
 _CPU = "cpu"
 _MEMORY = "memory"
 _PODS = "pods"
+
+
+def is_scalar_resource_name(name: str) -> bool:
+    """Extended-resource-style names (domain-prefixed) and hugepages count
+    as scalar resources, mirroring k8s v1helper.IsScalarResourceName as
+    used by the reference (resource_info.go:85-88)."""
+    return "/" in name or name.startswith("hugepages-")
 
 
 class Resource:
@@ -76,7 +89,12 @@ class Resource:
                 r.memory += float(quant)
             elif name == _PODS:
                 r.max_task_num += int(quant)
-            else:
+            elif is_scalar_resource_name(name):
+                # Gated like the reference's IsScalarResourceName check
+                # (resource_info.go:85-88): only extended resources
+                # (domain-prefixed, e.g. nvidia.com/gpu) and hugepages are
+                # tracked as scalars; other core names (ephemeral-storage)
+                # are ignored.
                 r.add_scalar(name, float(quant) * 1000.0)
         return r
 
@@ -107,14 +125,27 @@ class Resource:
         return self.scalars[name] < MIN_MILLI_SCALAR
 
     def less(self, rr: "Resource") -> bool:
-        """Strictly less in every dimension (reference resource_info.go:228-252)."""
+        """Strictly less in every dimension (reference resource_info.go:228-252).
+
+        Go nil-map parity ({} == nil): when neither side has scalars the
+        result is False even if cpu/memory are strictly less — this quirk
+        gates preempt.validateVictims / reclaim / enqueue upstream."""
         if not (self.milli_cpu < rr.milli_cpu and self.memory < rr.memory):
             return False
-        return all(q < rr.scalars.get(name, 0.0) for name, q in self.scalars.items())
+        if not self.scalars:
+            return bool(rr.scalars)
+        for name, q in self.scalars.items():
+            if not rr.scalars:
+                return False
+            if q >= rr.scalars.get(name, 0.0):
+                return False
+        return True
 
     def less_equal(self, rr: "Resource") -> bool:
         """Less-or-equal within epsilon per dimension — the admission check
-        (reference resource_info.go:255-278)."""
+        (reference resource_info.go:255-278). Go nil-map parity: a scalar
+        entry on the left with no scalars at all on the right fails, even
+        a zero-valued one."""
         if not (
             self.milli_cpu < rr.milli_cpu or abs(rr.milli_cpu - self.milli_cpu) < MIN_MILLI_CPU
         ):
@@ -122,6 +153,8 @@ class Resource:
         if not (self.memory < rr.memory or abs(rr.memory - self.memory) < MIN_MEMORY):
             return False
         for name, q in self.scalars.items():
+            if not rr.scalars:
+                return False
             rrq = rr.scalars.get(name, 0.0)
             if not (q < rrq or abs(rrq - q) < MIN_MILLI_SCALAR):
                 return False
@@ -137,15 +170,20 @@ class Resource:
         return self
 
     def sub(self, rr: "Resource") -> "Resource":
-        """Subtract; raises if rr does not fit (reference resource_info.go:146-166)."""
+        """Subtract; raises if rr does not fit (reference resource_info.go:146-166).
+
+        Go nil-map parity: when the receiver has no scalars at all, scalar
+        subtraction is skipped entirely (Sub's early return at :151-153) —
+        no negative residue is ever created on a scalar-free receiver."""
         if not rr.less_equal(self):
             raise ValueError(
                 f"Resource is not sufficient to do operation: <{self}> sub <{rr}>"
             )
         self.milli_cpu -= rr.milli_cpu
         self.memory -= rr.memory
-        for name, q in rr.scalars.items():
-            self.scalars[name] = self.scalars.get(name, 0.0) - q
+        if self.scalars:
+            for name, q in rr.scalars.items():
+                self.scalars[name] = self.scalars.get(name, 0.0) - q
         return self
 
     def set_max_resource(self, rr: "Resource") -> None:

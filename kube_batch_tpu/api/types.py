@@ -34,6 +34,39 @@ def allocated_status(status: TaskStatus) -> bool:
     return status in ALLOCATED_STATUSES
 
 
+_DISALLOWED_TRANSITIONS: frozenset[tuple[TaskStatus, TaskStatus]] = frozenset(
+    {
+        # Terminal states never transition back to active scheduling states.
+        (TaskStatus.SUCCEEDED, TaskStatus.PENDING),
+        (TaskStatus.SUCCEEDED, TaskStatus.ALLOCATED),
+        (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED),
+        (TaskStatus.SUCCEEDED, TaskStatus.BINDING),
+        (TaskStatus.FAILED, TaskStatus.ALLOCATED),
+        (TaskStatus.FAILED, TaskStatus.PIPELINED),
+        (TaskStatus.FAILED, TaskStatus.BINDING),
+    }
+)
+
+
 def validate_status_update(old: TaskStatus, new: TaskStatus) -> None:
-    """All transitions permitted (reference types.go:82-84)."""
-    return None
+    """Guard task status transitions. The reference stub allows everything
+    (types.go:82-84); this rebuild rejects the transitions that would
+    corrupt the gang barrier's ready-count accounting (a terminal task
+    re-entering the allocated set). Raises ValueError on a disallowed
+    transition."""
+    if (old, new) in _DISALLOWED_TRANSITIONS:
+        raise ValueError(f"invalid task status transition {old!s} -> {new!s}")
+
+
+class ValidateResult:
+    """Result of a JobValid check (reference api/types.go:69-80)."""
+
+    __slots__ = ("passed", "reason", "message")
+
+    def __init__(self, passed: bool, reason: str = "", message: str = "") -> None:
+        self.passed = passed
+        self.reason = reason
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"ValidateResult(passed={self.passed}, reason={self.reason!r})"

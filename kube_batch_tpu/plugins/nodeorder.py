@@ -1,0 +1,133 @@
+"""nodeorder plugin: weighted sum of upstream k8s priorities
+(reference pkg/scheduler/plugins/nodeorder/nodeorder.go:109-222).
+
+Implements the same four priorities with the k8s 1.13 formulas:
+
+- LeastRequested:  ((cap - req) * 10 // cap) per cpu/mem, averaged with
+  integer division (k8s least_requested.go).
+- BalancedResourceAllocation: 10 - |cpuFraction - memFraction| * 10,
+  floored; 0 when either fraction >= 1 (k8s balanced_resource_allocation.go).
+- NodeAffinity (preferred): raw sum of matching preferred-term weights —
+  the reference calls CalculateNodeAffinityPriorityMap without the
+  normalizing reduce (nodeorder.go:199-205), so the raw sum is parity.
+- InterPodAffinity: simplified count of resident pods matched by the
+  task's required affinity terms minus anti-affinity matches (the
+  reference's full symmetric-weight algorithm rebuilds an O(N^2) node map
+  per scored node — a known perf sink SURVEY.md section 2.6 — and is
+  deliberately not replicated; 0 when the task has no pod-affinity terms,
+  which keeps the fast path identical).
+
+All four are pure functions of (task request, node used/allocatable,
+labels), so the XLA path computes the first two on-device and the label
+terms as precomputed matrices (kube_batch_tpu.ops).
+"""
+
+from __future__ import annotations
+
+import math
+
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.framework.arguments import Arguments
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework.session import Session
+
+MAX_PRIORITY = 10  # schedulerapi.MaxPriority
+
+NODE_AFFINITY_WEIGHT = "nodeaffinity.weight"
+POD_AFFINITY_WEIGHT = "podaffinity.weight"
+LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
+BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
+
+
+def least_requested_score(requested_cpu: float, requested_mem: float,
+                          cap_cpu: float, cap_mem: float) -> int:
+    """k8s LeastRequestedPriorityMap: per-dimension integer score
+    ((cap-req)*10)//cap, clamped at 0, averaged with integer division."""
+
+    def dim(req: float, cap: float) -> int:
+        if cap == 0:
+            return 0
+        if req > cap:
+            return 0
+        return int(((cap - req) * MAX_PRIORITY) // cap)
+
+    return (dim(requested_cpu, cap_cpu) + dim(requested_mem, cap_mem)) // 2
+
+
+def balanced_resource_score(requested_cpu: float, requested_mem: float,
+                            cap_cpu: float, cap_mem: float) -> int:
+    """k8s BalancedResourceAllocationMap: 10 - |cpuF - memF| * 10 floored;
+    0 when either fraction >= 1."""
+
+    def fraction(req: float, cap: float) -> float:
+        return req / cap if cap != 0 else 1.0
+
+    cpu_f = fraction(requested_cpu, cap_cpu)
+    mem_f = fraction(requested_mem, cap_mem)
+    if cpu_f >= 1.0 or mem_f >= 1.0:
+        return 0
+    return int(MAX_PRIORITY - math.fabs(cpu_f - mem_f) * MAX_PRIORITY)
+
+
+def node_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
+    """Sum of preferred node-affinity term weights matching node labels."""
+    affinity = task.pod.affinity
+    if affinity is None or not affinity.node_affinity_preferred:
+        return 0
+    labels = node.node.labels if node.node else {}
+    return sum(w for w, term in affinity.node_affinity_preferred if term.matches(labels))
+
+
+def pod_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
+    """Simplified inter-pod affinity: matched resident pods minus
+    anti-matched (see module docstring)."""
+    affinity = task.pod.affinity
+    if affinity is None:
+        return 0
+    if not affinity.pod_affinity_required and not affinity.pod_anti_affinity_required:
+        return 0
+    score = 0
+    for resident in node.tasks.values():
+        labels = resident.pod.metadata.labels
+        for term in affinity.pod_affinity_required:
+            if all(labels.get(k) == v for k, v in term.label_selector.items()):
+                score += 1
+        for term in affinity.pod_anti_affinity_required:
+            if all(labels.get(k) == v for k, v in term.label_selector.items()):
+                score -= 1
+    return score
+
+
+class NodeOrderPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+
+    @property
+    def name(self) -> str:
+        return "nodeorder"
+
+    def on_session_open(self, ssn: Session) -> None:
+        # Weights default to 1 (nodeorder.go:139-153).
+        least_req_w = self.arguments.get_int(LEAST_REQUESTED_WEIGHT, 1)
+        balanced_w = self.arguments.get_int(BALANCED_RESOURCE_WEIGHT, 1)
+        node_aff_w = self.arguments.get_int(NODE_AFFINITY_WEIGHT, 1)
+        pod_aff_w = self.arguments.get_int(POD_AFFINITY_WEIGHT, 1)
+
+        def node_order_fn(task: TaskInfo, node: NodeInfo) -> float:
+            req_cpu = node.used.milli_cpu + task.resreq.milli_cpu
+            req_mem = node.used.memory + task.resreq.memory
+            cap_cpu = node.allocatable.milli_cpu
+            cap_mem = node.allocatable.memory
+            score = 0.0
+            score += least_requested_score(req_cpu, req_mem, cap_cpu, cap_mem) * least_req_w
+            score += balanced_resource_score(req_cpu, req_mem, cap_cpu, cap_mem) * balanced_w
+            score += node_affinity_score(task, node) * node_aff_w
+            score += pod_affinity_score(task, node) * pod_aff_w
+            return score
+
+        ssn.add_node_order_fn(self.name, node_order_fn)
+
+
+def new(arguments: Arguments) -> Plugin:
+    return NodeOrderPlugin(arguments)

@@ -4,6 +4,7 @@
 
 from __future__ import annotations
 
+import queue
 import re
 import threading
 from typing import Optional, Union
@@ -59,9 +60,10 @@ def build_resource_list(
     pods: int = 0,
     **scalars: Union[str, float],
 ) -> dict[str, float]:
-    """Resource list dict from k8s-style quantity strings. Scalar kwargs use
-    double-underscore for '/' and '.' (e.g. nvidia__com__gpu=2) or pass a
-    pre-built dict via build_resource_list(**{"nvidia.com/gpu": 2})."""
+    """Resource list dict from k8s-style quantity strings. Scalar kwargs
+    translate double-underscores: ``nvidia__com__gpu=2`` becomes
+    ``nvidia.com/gpu: 2`` (first ``__`` -> ``.``, second -> ``/``); or pass
+    a pre-built dict via build_resource_list(**{"nvidia.com/gpu": 2})."""
     rl: dict[str, float] = {}
     if cpu:
         rl["cpu"] = parse_quantity(cpu)
@@ -70,6 +72,10 @@ def build_resource_list(
     if pods:
         rl["pods"] = float(pods)
     for name, q in scalars.items():
+        if "__" in name:
+            # domain__suffix__resource -> domain.suffix/resource
+            parts = name.split("__")
+            name = ".".join(parts[:-1]) + "/" + parts[-1]
         rl[name] = parse_quantity(q)
     return rl
 
@@ -166,32 +172,35 @@ def build_resource(cpu: Union[str, float] = 0, memory: Union[str, float] = 0, **
 
 
 class FakeBinder:
-    """Records binds instead of calling an API server; signals a condition
-    per bind (reference util/test_utils.go:95-117)."""
+    """Records binds instead of calling an API server; delivers one signal
+    per bind, like the reference's Go channel (util/test_utils.go:95-117) —
+    a latching Event would let a test waiting for N binds pass after one."""
 
     def __init__(self) -> None:
         self.binds: dict[str, str] = {}  # "ns/name" -> node
-        self.channel: "threading.Event" = threading.Event()
+        self.channel: "queue.Queue[str]" = queue.Queue()
         self._lock = threading.Lock()
 
     def bind(self, pod: Pod, hostname: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
         with self._lock:
-            self.binds[f"{pod.namespace}/{pod.name}"] = hostname
-        self.channel.set()
+            self.binds[key] = hostname
+        self.channel.put(key)
 
 
 class FakeEvictor:
-    """reference util/test_utils.go:120-140."""
+    """reference util/test_utils.go:120-140; one signal per evict."""
 
     def __init__(self) -> None:
         self.evicts: list[str] = []
-        self.channel: "threading.Event" = threading.Event()
+        self.channel: "queue.Queue[str]" = queue.Queue()
         self._lock = threading.Lock()
 
     def evict(self, pod: Pod) -> None:
+        key = f"{pod.namespace}/{pod.name}"
         with self._lock:
-            self.evicts.append(f"{pod.namespace}/{pod.name}")
-        self.channel.set()
+            self.evicts.append(key)
+        self.channel.put(key)
 
 
 class FakeStatusUpdater:
@@ -212,3 +221,102 @@ class FakeVolumeBinder:
 
     def bind_volumes(self, task) -> None:
         return None
+
+
+def build_cluster(
+    pods: list[Pod],
+    nodes: list[Node],
+    pod_groups: Optional[list[PodGroup]] = None,
+    queues: Optional[list[Queue]] = None,
+):
+    """Wire pods/nodes/podgroups/queues into a ClusterInfo the way the
+    cache does (reference cache/event_handlers.go:43-88): tasks join jobs
+    via the group-name annotation (pods without one get a synthetic
+    single-member shadow job), bound/running tasks also land on their
+    node. Jobs whose PodGroup is Pending-phase get phase Inqueue so the
+    allocate action considers them (the enqueue action owns that gate in
+    a full pipeline)."""
+    from kube_batch_tpu.api.cluster_info import ClusterInfo
+    from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, get_job_id, job_key
+    from kube_batch_tpu.api.node_info import NodeInfo
+    from kube_batch_tpu.api.queue_info import QueueInfo
+    from kube_batch_tpu.apis.types import PodGroupPhase
+
+    cluster = ClusterInfo()
+    for node in nodes:
+        cluster.nodes[node.name] = NodeInfo(node)
+    for queue in queues or []:
+        cluster.queues[queue.name] = QueueInfo(queue)
+
+    for pg in pod_groups or []:
+        if pg.status.phase == PodGroupPhase.PENDING:
+            pg.status.phase = PodGroupPhase.INQUEUE
+        jid = job_key(pg.metadata.namespace, pg.name)
+        job = JobInfo(jid)
+        job.set_pod_group(pg)
+        cluster.jobs[jid] = job
+
+    for pod in pods:
+        task = TaskInfo(pod)
+        jid = get_job_id(pod) or f"{pod.namespace}/{pod.name}-shadow"
+        if jid not in cluster.jobs:
+            shadow = build_pod_group(
+                name=f"{pod.name}-shadow", namespace=pod.namespace, min_member=1
+            )
+            shadow.status.phase = PodGroupPhase.INQUEUE
+            job = JobInfo(jid)
+            job.set_pod_group(shadow)
+            cluster.jobs[jid] = job
+        task.job = jid
+        cluster.jobs[jid].add_task_info(task)
+        if task.node_name and task.node_name in cluster.nodes:
+            cluster.nodes[task.node_name].add_task(task)
+    return cluster
+
+
+class FakeCache:
+    """Session-facing cache with fake write-side, for action-level tests
+    (the pattern of reference actions/allocate/allocate_test.go:38-212:
+    real model, fake Binder/Evictor)."""
+
+    def __init__(
+        self,
+        cluster,
+        binder: Optional[FakeBinder] = None,
+        evictor: Optional[FakeEvictor] = None,
+        status_updater: Optional[FakeStatusUpdater] = None,
+        volume_binder: Optional[FakeVolumeBinder] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.binder = binder or FakeBinder()
+        self.evictor = evictor or FakeEvictor()
+        self.status_updater = status_updater or FakeStatusUpdater()
+        self.volume_binder = volume_binder or FakeVolumeBinder()
+
+    def snapshot(self):
+        from kube_batch_tpu.api.cluster_info import ClusterInfo
+
+        return ClusterInfo(
+            jobs={uid: job.clone() for uid, job in self.cluster.jobs.items()},
+            nodes={name: node.clone() for name, node in self.cluster.nodes.items()},
+            queues={name: q.clone() for name, q in self.cluster.queues.items()},
+        )
+
+    def bind(self, task, hostname: str) -> None:
+        self.binder.bind(task.pod, hostname)
+
+    def evict(self, task, reason: str) -> None:
+        self.evictor.evict(task.pod)
+
+    def update_job_status(self, job):
+        self.status_updater.update_pod_group(job.pod_group)
+        return job
+
+    def record_job_status_event(self, job) -> None:
+        return None
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task) -> None:
+        self.volume_binder.bind_volumes(task)

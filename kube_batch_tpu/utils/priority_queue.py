@@ -1,0 +1,58 @@
+"""Heap-backed scheduling queue ordered by a caller-supplied less-fn
+(reference pkg/scheduler/util/priority_queue.go:26-100).
+
+The less-fn returns True when the left item should pop before the right
+item, exactly like the reference's ``api.LessFn``. The item that the
+less-fn ranks first pops first; ties keep insertion order (the Go heap
+does not guarantee tie stability, but determinism here makes the serial
+path reproducible, which the XLA-equivalence property tests rely on).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Optional
+
+LessFn = Callable[[Any, Any], bool]
+
+
+class _Item:
+    __slots__ = ("value", "seq", "less_fn")
+
+    def __init__(self, value: Any, seq: int, less_fn: Optional[LessFn]) -> None:
+        self.value = value
+        self.seq = seq
+        self.less_fn = less_fn
+
+    def __lt__(self, other: "_Item") -> bool:
+        if self.less_fn is not None:
+            if self.less_fn(self.value, other.value):
+                return True
+            if self.less_fn(other.value, self.value):
+                return False
+        # Stable tie-break by insertion order (deterministic pops).
+        return self.seq < other.seq
+
+
+class PriorityQueue:
+    """reference priority_queue.go:26-67."""
+
+    def __init__(self, less_fn: Optional[LessFn] = None) -> None:
+        self._less_fn = less_fn
+        self._heap: list[_Item] = []
+        self._seq = itertools.count()
+
+    def push(self, value: Any) -> None:
+        heapq.heappush(self._heap, _Item(value, next(self._seq), self._less_fn))
+
+    def pop(self) -> Any:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap).value
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)

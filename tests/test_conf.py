@@ -1,0 +1,120 @@
+"""Conf schema/loader tests (reference util_test.go:27 pattern) plus
+regression tests for the round-1 defects (VERDICT weak #3/#6/#7)."""
+
+import pytest
+
+from kube_batch_tpu import actions  # noqa: F401
+from kube_batch_tpu.api.helpers import min_resource
+from kube_batch_tpu.api.resource_info import Resource
+from kube_batch_tpu.api.types import TaskStatus, validate_status_update
+from kube_batch_tpu.conf import (
+    DEFAULT_SCHEDULER_CONF,
+    load_scheduler_conf,
+    parse_scheduler_conf,
+)
+from kube_batch_tpu.testing import build_resource_list
+
+
+class TestConfParse:
+    def test_default_conf(self):
+        actions_list, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        assert [a.name for a in actions_list] == ["allocate", "backfill"]
+        assert len(tiers) == 2
+        assert [p.name for p in tiers[0].plugins] == ["priority", "gang"]
+        assert [p.name for p in tiers[1].plugins] == [
+            "drf",
+            "predicates",
+            "proportion",
+            "nodeorder",
+        ]
+
+    def test_enable_flags_default_true(self):
+        conf = parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        for tier in conf.tiers:
+            for option in tier.plugins:
+                assert option.enabled_job_order is True
+                assert option.enabled_predicate is True
+
+    def test_explicit_flag_respected(self):
+        conf = parse_scheduler_conf(
+            """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+    enableJobOrder: false
+    arguments:
+      foo: "7"
+"""
+        )
+        option = conf.tiers[0].plugins[0]
+        assert option.enabled_job_order is False
+        assert option.enabled_job_ready is True
+        assert option.arguments == {"foo": "7"}
+
+    def test_unknown_action_raises(self):
+        with pytest.raises(ValueError):
+            load_scheduler_conf('actions: "no-such-action"')
+
+    def test_full_pipeline_order(self):
+        actions_list, _ = load_scheduler_conf(
+            'actions: "enqueue, reclaim, allocate, backfill, preempt"'
+        )
+        assert [a.name for a in actions_list] == [
+            "enqueue",
+            "reclaim",
+            "allocate",
+            "backfill",
+            "preempt",
+        ]
+
+
+class TestRound1Fixes:
+    def test_build_resource_list_kwarg_translation(self):
+        rl = build_resource_list(cpu=1, nvidia__com__gpu=2)
+        assert rl == {"cpu": 1.0, "nvidia.com/gpu": 2.0}
+        rl = build_resource_list(google__com__tpu=8)
+        assert rl == {"google.com/tpu": 8.0}
+
+    def test_from_resource_list_ignores_non_scalar_names(self):
+        r = Resource.from_resource_list(
+            {"cpu": 1, "ephemeral-storage": 10_000_000, "nvidia.com/gpu": 2}
+        )
+        assert r.scalars == {"nvidia.com/gpu": 2000.0}
+
+    def test_sub_scalar_onto_scalar_free_receiver_raises(self):
+        # Go parity: LessEqual returns false when the subtrahend has a
+        # scalar entry and the receiver has none (resource_info.go:264-267),
+        # so Sub panics before its (dead) nil-map early return — no
+        # negative residue can appear on a scalar-free receiver.
+        r = Resource(milli_cpu=1000, memory=1000)
+        with pytest.raises(ValueError):
+            r.sub(Resource(milli_cpu=500, memory=500, scalars={"g": 5}))
+        assert r.scalars == {}
+
+    def test_min_resource_drops_scalars_when_either_side_nil(self):
+        l = Resource(milli_cpu=100, memory=100, scalars={"g": 5})
+        r = Resource(milli_cpu=200, memory=50)
+        out = min_resource(l, r)
+        assert out.milli_cpu == 100 and out.memory == 50
+        assert out.scalars == {}
+        both = min_resource(l, Resource(milli_cpu=0, memory=0, scalars={"g": 2}))
+        assert both.scalars == {"g": 2}
+
+    def test_validate_status_update_rejects_terminal_reentry(self):
+        with pytest.raises(ValueError):
+            validate_status_update(TaskStatus.SUCCEEDED, TaskStatus.ALLOCATED)
+        # Normal flow stays permitted.
+        validate_status_update(TaskStatus.PENDING, TaskStatus.ALLOCATED)
+        validate_status_update(TaskStatus.ALLOCATED, TaskStatus.BINDING)
+        validate_status_update(TaskStatus.RUNNING, TaskStatus.RELEASING)
+
+    def test_fake_binder_signals_once_per_bind(self):
+        from kube_batch_tpu.testing import FakeBinder, build_pod
+
+        binder = FakeBinder()
+        binder.bind(build_pod(name="a"), "n1")
+        binder.bind(build_pod(name="b"), "n2")
+        assert binder.channel.get_nowait() == "default/a"
+        assert binder.channel.get_nowait() == "default/b"
+        assert binder.channel.empty()

@@ -1,0 +1,351 @@
+"""Unit tests pinning the tiered dispatch semantics
+(reference session_plugins.go:90-440) and Statement transactions
+(statement.go:26-222)."""
+
+import pytest
+
+from kube_batch_tpu.api.types import TaskStatus, ValidateResult
+from kube_batch_tpu.conf import PluginOption, Tier, apply_plugin_conf_defaults
+from kube_batch_tpu.framework import (
+    Arguments,
+    EventHandler,
+    Plugin,
+    Session,
+    cleanup_plugin_builders,
+    open_session,
+    register_plugin_builder,
+)
+from kube_batch_tpu.testing import (
+    FakeCache,
+    build_cluster,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+def make_tier(*names, **flag_overrides):
+    options = []
+    for name in names:
+        opt = PluginOption(name=name)
+        for k, v in flag_overrides.get(name, {}).items() if isinstance(flag_overrides.get(name), dict) else []:
+            setattr(opt, k, v)
+        apply_plugin_conf_defaults(opt)
+        options.append(opt)
+    return Tier(plugins=options)
+
+
+class RecordingPlugin(Plugin):
+    """Registers whatever fns a test hands it."""
+
+    def __init__(self, name, fns):
+        self._name = name
+        self._fns = fns
+
+    @property
+    def name(self):
+        return self._name
+
+    def on_session_open(self, ssn):
+        for kind, fn in self._fns.items():
+            getattr(ssn, f"add_{kind}")(self._name, fn)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    cleanup_plugin_builders()
+    # Re-register the built-ins for other test modules.
+    from kube_batch_tpu.plugins.factory import register_all_plugins
+
+    register_all_plugins()
+
+
+def open_with(plugins, tiers, cluster=None):
+    for name, fns in plugins.items():
+        register_plugin_builder(
+            name, lambda args, name=name, fns=fns: RecordingPlugin(name, fns)
+        )
+    cache = FakeCache(cluster or build_cluster([], []))
+    return open_session(cache, tiers)
+
+
+def two_job_cluster():
+    pods = [
+        build_pod(name="p1", group_name="j1", req=build_resource_list(cpu=1)),
+        build_pod(name="p2", group_name="j2", req=build_resource_list(cpu=1)),
+    ]
+    groups = [build_pod_group("j1"), build_pod_group("j2")]
+    nodes = [build_node("n1", build_resource_list(cpu=4, memory="4Gi", pods=10))]
+    return build_cluster(pods, nodes, groups, [build_queue("default")])
+
+
+class TestOrderDispatch:
+    def test_first_nonzero_across_tiers_wins(self):
+        # Tier 1 plugin says equal; tier 2 plugin decides.
+        ssn = open_with(
+            {
+                "a": {"job_order_fn": lambda l, r: 0},
+                "b": {"job_order_fn": lambda l, r: -1 if l.name == "j2" else 1},
+            },
+            [make_tier("a"), make_tier("b")],
+            two_job_cluster(),
+        )
+        j1 = next(j for j in ssn.jobs.values() if j.name == "j1")
+        j2 = next(j for j in ssn.jobs.values() if j.name == "j2")
+        assert ssn.job_order_fn(j2, j1) is True
+        assert ssn.job_order_fn(j1, j2) is False
+
+    def test_earlier_tier_shadow_later(self):
+        ssn = open_with(
+            {
+                "a": {"job_order_fn": lambda l, r: -1 if l.name == "j1" else 1},
+                "b": {"job_order_fn": lambda l, r: -1 if l.name == "j2" else 1},
+            },
+            [make_tier("a"), make_tier("b")],
+            two_job_cluster(),
+        )
+        j1 = next(j for j in ssn.jobs.values() if j.name == "j1")
+        j2 = next(j for j in ssn.jobs.values() if j.name == "j2")
+        assert ssn.job_order_fn(j1, j2) is True
+
+    def test_fallback_creation_time_then_uid(self):
+        cluster = two_job_cluster()
+        jobs = list(cluster.jobs.values())
+        jobs[0].creation_timestamp = 100.0
+        jobs[1].creation_timestamp = 50.0
+        ssn = open_with({}, [], cluster)
+        younger = next(j for j in ssn.jobs.values() if j.creation_timestamp == 50.0)
+        older = next(j for j in ssn.jobs.values() if j.creation_timestamp == 100.0)
+        assert ssn.job_order_fn(younger, older) is True
+        # Equal timestamps: UID decides.
+        older.creation_timestamp = 50.0
+        lo, hi = sorted([younger, older], key=lambda j: j.uid)
+        assert ssn.job_order_fn(lo, hi) is True
+        assert ssn.job_order_fn(hi, lo) is False
+
+    def test_disabled_flag_skips_plugin(self):
+        tier = Tier(plugins=[PluginOption(name="a", enabled_job_order=False)])
+        apply_plugin_conf_defaults(tier.plugins[0])
+        ssn = open_with(
+            {"a": {"job_order_fn": lambda l, r: -1 if l.name == "j2" else 1}},
+            [tier],
+            two_job_cluster(),
+        )
+        j1 = next(j for j in ssn.jobs.values() if j.name == "j1")
+        j2 = next(j for j in ssn.jobs.values() if j.name == "j2")
+        j1.creation_timestamp = 1.0
+        j2.creation_timestamp = 2.0
+        # Plugin would favor j2, but it's disabled -> creation time wins.
+        assert ssn.job_order_fn(j1, j2) is True
+
+
+class TestPredicateAndScoreDispatch:
+    def test_predicates_and_semantics(self):
+        calls = []
+
+        def ok(task, node):
+            calls.append("ok")
+
+        def fail(task, node):
+            raise RuntimeError("nope")
+
+        ssn = open_with(
+            {"a": {"predicate_fn": ok}, "b": {"predicate_fn": fail}},
+            [make_tier("a", "b")],
+            two_job_cluster(),
+        )
+        task = next(iter(next(iter(ssn.jobs.values())).tasks.values()))
+        node = next(iter(ssn.nodes.values()))
+        with pytest.raises(RuntimeError):
+            ssn.predicate_fn(task, node)
+        assert calls == ["ok"]  # AND short-circuits at first failure
+
+    def test_node_order_sums_across_plugins(self):
+        ssn = open_with(
+            {
+                "a": {"node_order_fn": lambda t, n: 3.0},
+                "b": {"node_order_fn": lambda t, n: 4.0},
+            },
+            [make_tier("a"), make_tier("b")],
+            two_job_cluster(),
+        )
+        task = next(iter(next(iter(ssn.jobs.values())).tasks.values()))
+        node = next(iter(ssn.nodes.values()))
+        assert ssn.node_order_fn(task, node) == 7.0
+
+
+class TestVictimDispatch:
+    def _session(self, plugin_victims, tiers):
+        fns = {}
+        for name, picker in plugin_victims.items():
+            fns[name] = {"preemptable_fn": picker}
+        return open_with(fns, tiers, two_job_cluster())
+
+    def test_intersection_within_tier(self):
+        ssn = self._session(
+            {
+                "a": lambda p, cands: [c for c in cands if c.name in ("v1", "v2")],
+                "b": lambda p, cands: [c for c in cands if c.name in ("v2", "v3")],
+            },
+            [make_tier("a", "b")],
+        )
+        from kube_batch_tpu.testing import build_task
+
+        preemptor = build_task(name="p")
+        cands = [build_task(name=n) for n in ("v1", "v2", "v3")]
+        victims = ssn.preemptable(preemptor, cands)
+        assert [v.name for v in victims] == ["v2"]
+
+    def test_empty_tier_result_falls_through(self):
+        """Go parity: plugins return nil slices when they select nothing,
+        so a zero-victim tier defers to the next tier
+        (session_plugins.go:126-131 with nil-when-empty slices)."""
+        ssn = self._session(
+            {
+                "a": lambda p, cands: [],  # "no victims" == nil in Go
+                "b": lambda p, cands: list(cands),
+            },
+            [make_tier("a"), make_tier("b")],
+        )
+        from kube_batch_tpu.testing import build_task
+
+        victims = ssn.preemptable(build_task(name="p"), [build_task(name="v1")])
+        assert [v.name for v in victims] == ["v1"]
+
+    def test_empty_intersection_falls_through(self):
+        """Disjoint picks within a tier -> empty intersection -> next tier
+        decides (the Go intersection slice is nil when empty)."""
+        ssn = self._session(
+            {
+                "a": lambda p, cands: [c for c in cands if c.name == "v1"],
+                "b": lambda p, cands: [c for c in cands if c.name == "v2"],
+                "c": lambda p, cands: list(cands),
+            },
+            [make_tier("a", "b"), make_tier("c")],
+        )
+        from kube_batch_tpu.testing import build_task
+
+        cands = [build_task(name="v1"), build_task(name="v2")]
+        victims = ssn.preemptable(build_task(name="p"), cands)
+        assert sorted(v.name for v in victims) == ["v1", "v2"]
+
+    def test_tier_without_fns_defers(self):
+        ssn = self._session(
+            {"b": lambda p, cands: list(cands)},
+            [make_tier("a"), make_tier("b")],
+        )
+        from kube_batch_tpu.testing import build_task
+
+        victims = ssn.preemptable(build_task(name="p"), [build_task(name="v1")])
+        assert [v.name for v in victims] == ["v1"]
+
+
+class TestValidateDispatch:
+    def test_job_valid_first_failure(self):
+        ssn = open_with(
+            {
+                "a": {"job_valid_fn": lambda job: None},
+                "b": {
+                    "job_valid_fn": lambda job: ValidateResult(False, "r", "m")
+                    if job.name == "j2"
+                    else None
+                },
+            },
+            [make_tier("a", "b")],
+            two_job_cluster(),
+        )
+        # j2 was rejected at session open and removed (gate).
+        assert sorted(j.name for j in ssn.jobs.values()) == ["j1"]
+
+    def test_overused_or(self):
+        ssn = open_with(
+            {
+                "a": {"overused_fn": lambda q: False},
+                "b": {"overused_fn": lambda q: True},
+            },
+            [make_tier("a", "b")],
+            two_job_cluster(),
+        )
+        queue = next(iter(ssn.queues.values()))
+        assert ssn.overused(queue) is True
+
+    def test_job_ready_and(self):
+        ssn = open_with(
+            {
+                "a": {"job_ready_fn": lambda j: True},
+                "b": {"job_ready_fn": lambda j: False},
+            },
+            [make_tier("a", "b")],
+            two_job_cluster(),
+        )
+        job = next(iter(ssn.jobs.values()))
+        assert ssn.job_ready(job) is False
+
+
+class TestStatement:
+    def _running_cluster(self):
+        pods = [
+            build_pod(
+                name="victim",
+                group_name="jv",
+                req=build_resource_list(cpu=1),
+                node_name="n1",
+            ),
+            build_pod(name="starved", group_name="js", req=build_resource_list(cpu=1)),
+        ]
+        from kube_batch_tpu.apis.types import PodPhase
+
+        pods[0].phase = PodPhase.RUNNING
+        groups = [build_pod_group("jv"), build_pod_group("js")]
+        nodes = [build_node("n1", build_resource_list(cpu=1, memory="1Gi", pods=10))]
+        return build_cluster(pods, nodes, groups, [build_queue("default")])
+
+    def test_discard_restores_session_state(self):
+        ssn = open_with({}, [], self._running_cluster())
+        victim_job = next(j for j in ssn.jobs.values() if j.name == "jv")
+        starved_job = next(j for j in ssn.jobs.values() if j.name == "js")
+        victim = next(iter(victim_job.tasks.values()))
+        starved = next(iter(starved_job.tasks.values()))
+        node = ssn.nodes["n1"]
+        idle_before = node.idle.clone()
+
+        stmt = ssn.statement()
+        stmt.evict(victim, "test")
+        assert victim.status == TaskStatus.RELEASING
+        stmt.pipeline(starved, "n1")
+        assert starved.status == TaskStatus.PIPELINED
+
+        stmt.discard()
+        assert victim.status == TaskStatus.RUNNING
+        assert starved.status == TaskStatus.PENDING
+        assert starved.node_name == ""
+        assert node.idle == idle_before
+        assert ssn.cache.evictor.evicts == []
+
+    def test_commit_replays_evictions_to_cache(self):
+        ssn = open_with({}, [], self._running_cluster())
+        victim_job = next(j for j in ssn.jobs.values() if j.name == "jv")
+        victim = next(iter(victim_job.tasks.values()))
+        stmt = ssn.statement()
+        stmt.evict(victim, "test")
+        stmt.commit()
+        assert ssn.cache.evictor.evicts == ["default/victim"]
+
+    def test_event_handlers_fire_and_unwind(self):
+        events = []
+        ssn = open_with({}, [], self._running_cluster())
+        ssn.add_event_handler(
+            EventHandler(
+                allocate_func=lambda e: events.append(("alloc", e.task.name)),
+                deallocate_func=lambda e: events.append(("dealloc", e.task.name)),
+            )
+        )
+        victim_job = next(j for j in ssn.jobs.values() if j.name == "jv")
+        victim = next(iter(victim_job.tasks.values()))
+        stmt = ssn.statement()
+        stmt.evict(victim, "test")
+        stmt.discard()
+        assert events == [("dealloc", "victim"), ("alloc", "victim")]

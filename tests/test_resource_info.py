@@ -65,8 +65,14 @@ class TestPredicates:
     @pytest.mark.parametrize(
         "l,r,expected",
         [
-            (res(100, 100), res(200, 200), True),
+            # Go nil-map parity ({} == nil, resource_info.go:234-239):
+            # both scalar-free -> False even when cpu/mem strictly less.
+            # This quirk gates preempt.validateVictims (preempt.go:268),
+            # reclaim (reclaim.go:156) and enqueue's brake (enqueue.go:88).
+            (res(100, 100), res(200, 200), False),
             (res(100, 100), res(100, 200), False),  # not strictly less on cpu
+            # Left nil, right has scalars -> True (resource_info.go:235-240).
+            (res(100, 100), res(200, 200, {"g": 2}), True),
             (res(100, 100, {"g": 1}), res(200, 200, {"g": 2}), True),
             (res(100, 100, {"g": 2}), res(200, 200, {"g": 2}), False),
             (res(100, 100, {"g": 1}), res(200, 200), False),  # scalar missing on r
@@ -75,6 +81,16 @@ class TestPredicates:
     def test_less(self, l, r, expected):
         assert l.less(r) is expected
 
+    def test_less_policy_call_sites(self):
+        """The nil-map quirk at its policy call sites: a victim set whose
+        aggregate resreq is scalar-free never fails preempt's
+        validateVictims 'not enough resources' check, exactly like Go."""
+        victims_total = res(500, 500)  # cpu/mem only
+        resreq = res(1000, 1000)
+        assert victims_total.less(resreq) is False  # Go: both nil -> False
+        # With scalars on both sides the check becomes meaningful again.
+        assert res(500, 500, {"g": 1}).less(res(1000, 1000, {"g": 2})) is True
+
     @pytest.mark.parametrize(
         "l,r,expected",
         [
@@ -82,7 +98,11 @@ class TestPredicates:
             (res(100 + MIN_MILLI_CPU - 1, 100), res(100, 100), True),
             (res(100 + MIN_MILLI_CPU, 100), res(100, 100), False),
             (res(0, 100 + MIN_MEMORY), res(0, 100), False),
-            (res(0, 0, {"g": 5}), res(0, 0), True),  # scalar within epsilon of 0
+            # Go nil-map parity (resource_info.go:264-267): any scalar
+            # entry on the left vs no scalars at all on the right -> False,
+            # even within epsilon of zero.
+            (res(0, 0, {"g": 5}), res(0, 0), False),
+            (res(0, 0, {"g": 5}), res(0, 0, {"h": 1}), True),  # epsilon vs present map
             (res(0, 0, {"g": MIN_MILLI_SCALAR}), res(0, 0), False),
         ],
     )
