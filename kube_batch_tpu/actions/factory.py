@@ -14,8 +14,8 @@ def register_all_actions() -> None:
     register_action(preempt.new())
     register_action(reclaim.new())
 
-    # The vectorized TPU path registers lazily: importing jax is deferred
-    # until something asks for the action by name.
+    # The vectorized TPU path needs jax; without it the scheduler still
+    # works serially and a conf naming xla_allocate fails at load time.
     try:
         from kube_batch_tpu.actions import xla_allocate
 

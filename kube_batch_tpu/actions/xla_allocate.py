@@ -1,0 +1,169 @@
+"""xla_allocate action: the allocate loop as one XLA program.
+
+Drop-in replacement for the serial allocate action (conf
+``actions: "enqueue, xla_allocate, backfill"``): encodes the session
+snapshot to SoA tensors (ops.encode), runs the jitted gang-aware solve
+(ops.kernels.solve_allocate) that vectorizes the reference's per-task
+node scans (scheduler_helper.go:34-109) over the whole node axis, then
+replays the resulting assignments through the ordinary session mutations
+in kernel order — so plugin event handlers, the gang dispatch barrier
+(session.go:285-293) and cache binds fire exactly as the serial action
+would have fired them.
+
+Scope guard: snapshots outside the kernel's modeled policy envelope fall
+back to the serial action for that cycle (correctness first):
+
+- pending tasks with required pod (anti-)affinity — pairwise-dynamic
+  predicate (predicates.go:187-199), host-side only;
+- tiers enabling plugins with dynamic ordering/share state the kernel
+  does not yet fold into its loop (drf, proportion).
+
+NodesFitDelta diagnostics (allocate.go:139-145,162-168) are not
+reproduced — they are human-readable FitError text, not policy.
+"""
+
+from __future__ import annotations
+
+import jax  # noqa: F401  -- fail registration, not mid-cycle, when absent
+import numpy as np
+
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import Session
+
+# Plugins whose session hooks the kernel models exactly (priority/gang
+# ordering + barrier, predicates masks, nodeorder score) or that register
+# nothing the allocate path consults (conformance: preempt/reclaim only).
+_SUPPORTED_PLUGINS = {"priority", "gang", "predicates", "nodeorder", "conformance"}
+
+
+def _nodeorder_weights(ssn: Session) -> tuple[float, float, float]:
+    """(w_least, w_balanced, w_aff) from the tiers, matching the serial
+    plugin's defaults (nodeorder.go:139-153)."""
+    from kube_batch_tpu.framework.arguments import Arguments
+    from kube_batch_tpu.plugins.nodeorder import (
+        BALANCED_RESOURCE_WEIGHT,
+        LEAST_REQUESTED_WEIGHT,
+        NODE_AFFINITY_WEIGHT,
+    )
+
+    for tier in ssn.tiers:
+        for option in tier.plugins:
+            if option.name == "nodeorder" and option.enabled_node_order:
+                args = Arguments(option.arguments)
+                return (
+                    args.get_int(LEAST_REQUESTED_WEIGHT, 1),
+                    args.get_int(BALANCED_RESOURCE_WEIGHT, 1),
+                    args.get_int(NODE_AFFINITY_WEIGHT, 1),
+                )
+    return 0.0, 0.0, 0.0
+
+
+# The per-plugin enable flags the conf schema knows (conf/__init__.py);
+# the kernel models the all-defaults (True) configuration of each.
+_ENABLE_FLAGS = (
+    "enabled_job_order",
+    "enabled_job_ready",
+    "enabled_job_pipelined",
+    "enabled_task_order",
+    "enabled_preemptable",
+    "enabled_reclaimable",
+    "enabled_queue_order",
+    "enabled_predicate",
+    "enabled_node_order",
+)
+
+
+def _kernel_supported(ssn: Session) -> bool:
+    """True when the tiers describe exactly the policy the kernel
+    hardwires: priority ordering first, then the gang barrier, with
+    predicate masks on — i.e. the reference's default tier-1 plus
+    predicates/nodeorder. Anything else (extra plugins, disabled enable
+    flags, gang before priority, missing gang/predicates) would make the
+    kernel silently diverge from the serial oracle, so it falls back."""
+    order: list[str] = []
+    for tier in ssn.tiers:
+        for option in tier.plugins:
+            if option.name not in _SUPPORTED_PLUGINS:
+                return False
+            if not all(getattr(option, flag, True) for flag in _ENABLE_FLAGS):
+                return False
+            order.append(option.name)
+    # priority + gang must both be present, priority first (the kernel's
+    # job/task keys are (-prio, ready, creation/uid) in that order).
+    if "priority" not in order or "gang" not in order:
+        return False
+    if order.index("priority") > order.index("gang"):
+        return False
+    return "predicates" in order
+
+
+class XlaAllocateAction(Action):
+    """The TPU-native allocate. Falls back to serial when out of envelope."""
+
+    def __init__(self, dtype=None) -> None:
+        # float64 gives bit-parity with the serial float64 path (CPU
+        # equivalence tests); float32 is the TPU bench dtype — exact for
+        # milli/MiB-granular quantities (ops/encode.py docstring).
+        self._dtype = dtype
+
+    @property
+    def name(self) -> str:
+        return "xla_allocate"
+
+    def execute(self, ssn: Session) -> None:
+        from kube_batch_tpu.ops.encode import encode_session
+        from kube_batch_tpu.ops.kernels import (
+            KIND_ALLOCATED,
+            KIND_PIPELINED,
+            solve_allocate,
+        )
+
+        if not _kernel_supported(ssn):
+            self._fallback(ssn)
+            return
+
+        import jax.numpy as jnp
+
+        dtype = self._dtype
+        if dtype is None:
+            dtype = np.float64 if jnp.zeros(0).dtype == np.float64 else np.float32
+
+        enc = encode_session(ssn.jobs, ssn.nodes, ssn.queues, dtype=dtype)
+        if enc.has_host_only:
+            self._fallback(ssn)
+            return
+        if not enc.tasks:
+            return
+
+        w_least, w_balanced, w_aff = _nodeorder_weights(ssn)
+        arrays = dict(enc.arrays)
+        arrays["w_least"] = dtype(w_least)
+        arrays["w_balanced"] = dtype(w_balanced)
+        arrays["w_aff"] = dtype(w_aff)
+
+        result = solve_allocate(arrays)
+        assigned_node = np.asarray(result.assigned_node)
+        assigned_kind = np.asarray(result.assigned_kind)
+        assign_pos = np.asarray(result.assign_pos)
+
+        # Replay in kernel assignment order so event handlers and the
+        # gang dispatch barrier fire in the serial action's order.
+        rows = np.nonzero(assign_pos >= 0)[0]
+        rows = rows[np.argsort(assign_pos[rows], kind="stable")]
+        for row in rows:
+            task = enc.tasks[row]
+            hostname = enc.node_names[int(assigned_node[row])]
+            if assigned_kind[row] == KIND_ALLOCATED:
+                ssn.allocate(task, hostname)
+            elif assigned_kind[row] == KIND_PIPELINED:
+                ssn.pipeline(task, hostname)
+
+    @staticmethod
+    def _fallback(ssn: Session) -> None:
+        from kube_batch_tpu.actions.allocate import AllocateAction
+
+        AllocateAction().execute(ssn)
+
+
+def new() -> Action:
+    return XlaAllocateAction()

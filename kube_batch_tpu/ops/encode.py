@@ -1,0 +1,339 @@
+"""Snapshot -> struct-of-arrays tensor encoder (the TPU path's front end).
+
+Encodes the session view (jobs/nodes/queues, reference
+pkg/scheduler/api/cluster_info.go:22-26) into dense, padded, fixed-width
+arrays that `kernels.solve_allocate` consumes in one jitted program:
+
+- resource rows follow the `Resource.to_vector` contract
+  ``[milli_cpu, memory, *scalar_slots]`` with the per-slot epsilon vector
+  (api/resource_info.py);
+- the label-world predicates (node selector, required node affinity,
+  taints/tolerations, cordon) and the preferred-node-affinity score are
+  **deduplicated into (task-group x node-group) matrices**: tasks sharing
+  a pod spec signature and nodes sharing a label/taint signature hit the
+  same pure check functions (plugins/predicates.py, plugins/nodeorder.py)
+  exactly once per group pair, then broadcast by integer gather on device.
+  A 10k-task job is one group, so encoding is O(T + N + GT*GN), not O(T*N);
+- host ports become a small boolean incidence over the distinct ports
+  pending tasks actually use, so conflicts with both residents and
+  newly-assigned tasks are dynamic bitmask tests in the kernel;
+- everything is padded to power-of-two buckets (static shapes for XLA,
+  SURVEY.md section 7 hard part (e)) with validity masks.
+
+Tasks using required pod (anti-)affinity are flagged ``host_only``: that
+predicate is pairwise-dynamic over resident pods (reference
+predicates.go:187-199) and stays on the serial path (actions/xla_allocate
+falls back for such snapshots).
+
+Dtype: float64 arrays make the XLA path bit-identical to the serial
+float64 Python path (the equivalence property tests run this way on CPU);
+the TPU bench path uses float32, which is exact for milli-CPU-granular
+cpu and MiB-granular memory (values stay on a 2^20-multiple grid well
+inside the 24-bit mantissa).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kube_batch_tpu.api.job_info import JobInfo, TaskInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.resource_info import Resource
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.apis.types import PodGroupPhase
+from kube_batch_tpu.plugins.nodeorder import node_affinity_score
+from kube_batch_tpu.plugins.predicates import (
+    check_node_condition,
+    check_node_selector,
+    check_node_unschedulable,
+    check_pressure,
+    check_taints,
+)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket >= max(n, 1) so XLA recompiles only on
+    bucket crossings, not on every pod/node churn."""
+    size = max(n, 1, minimum)
+    return 1 << (size - 1).bit_length()
+
+
+def _task_signature(task: TaskInfo) -> tuple:
+    pod = task.pod
+    return (
+        tuple(sorted(pod.node_selector.items())),
+        repr(pod.affinity),
+        tuple(sorted(repr(t) for t in pod.tolerations)),
+    )
+
+
+def _node_signature(node: NodeInfo) -> tuple:
+    n = node.node
+    if n is None:
+        return (None,)
+    return (
+        tuple(sorted(n.labels.items())),
+        tuple(sorted(repr(t) for t in n.taints)),
+        bool(n.unschedulable),
+    )
+
+
+def _task_ports(task: TaskInfo) -> frozenset[int]:
+    return frozenset(p for c in task.pod.containers for p in c.ports)
+
+
+@dataclass
+class EncodedSnapshot:
+    """The dense snapshot + the host-side metadata needed to decode the
+    kernel's assignment back into session mutations."""
+
+    scalar_names: tuple[str, ...]
+    tasks: list[TaskInfo]  # row order
+    jobs: list[JobInfo]  # row order
+    queues: list[QueueInfo]  # row order
+    node_names: list[str]  # row order (sorted, = utils.get_node_list order)
+    n_tasks: int
+    n_nodes: int
+    n_jobs: int
+    n_queues: int
+    host_only: list[TaskInfo] = field(default_factory=list)
+    arrays: dict = field(default_factory=dict)
+
+    @property
+    def has_host_only(self) -> bool:
+        return bool(self.host_only)
+
+
+def _collect_scalar_names(
+    tasks: Sequence[TaskInfo], nodes: Sequence[NodeInfo]
+) -> tuple[str, ...]:
+    names: set[str] = set()
+    for t in tasks:
+        names.update(t.resreq.scalars)
+        names.update(t.init_resreq.scalars)
+    for n in nodes:
+        names.update(n.idle.scalars)
+        names.update(n.releasing.scalars)
+        names.update(n.allocatable.scalars)
+        names.update(n.used.scalars)
+    return tuple(sorted(names))
+
+
+def encode_session(
+    jobs: dict[str, JobInfo],
+    nodes: dict[str, NodeInfo],
+    queues: dict[str, QueueInfo],
+    dtype=np.float64,
+    pad: bool = True,
+) -> EncodedSnapshot:
+    """Build the SoA snapshot for one allocate solve.
+
+    Job/task eligibility mirrors the serial allocate action exactly
+    (reference allocate.go:48-70,120-125): Pending-phase PodGroups wait
+    for enqueue, jobs of unknown queues are skipped, BestEffort
+    (empty-resreq) tasks are backfill's business.
+    """
+    node_list = [nodes[name] for name in sorted(nodes)]
+    queue_list = sorted(
+        queues.values(), key=lambda q: (q.queue.metadata.creation_timestamp, q.uid)
+    )
+    queue_idx = {q.name: i for i, q in enumerate(queue_list)}
+
+    job_list: list[JobInfo] = []
+    job_pending: dict[str, list[TaskInfo]] = {}
+    for job in jobs.values():
+        if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+            continue
+        if job.queue not in queues:
+            continue
+        pending = [
+            t
+            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            if not t.resreq.is_empty()
+        ]
+        if not pending:
+            continue
+        job_list.append(job)
+        job_pending[job.uid] = pending
+    # Stable row order for reproducibility (selection order is decided by
+    # the rank arrays below, not row order).
+    job_list.sort(key=lambda j: (j.creation_timestamp, j.uid))
+    job_idx = {j.uid: i for i, j in enumerate(job_list)}
+
+    task_list: list[TaskInfo] = []
+    host_only: list[TaskInfo] = []
+    for job in job_list:
+        pending = job_pending[job.uid]
+        # Within-job pop order = priority desc, creation, uid (priority
+        # plugin task_order_fn + session fallback, session_plugins.go:329-341).
+        pending.sort(
+            key=lambda t: (-t.priority, t.pod.metadata.creation_timestamp, t.uid)
+        )
+        for t in pending:
+            aff = t.pod.affinity
+            if aff is not None and (aff.pod_affinity_required or aff.pod_anti_affinity_required):
+                host_only.append(t)
+            task_list.append(t)
+
+    scalar_names = _collect_scalar_names(task_list, node_list)
+    R = 2 + len(scalar_names)
+    t_n, n_n, j_n, q_n = len(task_list), len(node_list), len(job_list), len(queue_list)
+    T = _bucket(t_n) if pad else max(t_n, 1)
+    N = _bucket(n_n) if pad else max(n_n, 1)
+    J = _bucket(j_n, 4) if pad else max(j_n, 1)
+    Q = _bucket(q_n, 2) if pad else max(q_n, 1)
+
+    # -- ports ---------------------------------------------------------------
+    interesting_ports = sorted({p for t in task_list for p in _task_ports(t)})
+    port_idx = {p: i for i, p in enumerate(interesting_ports)}
+    P = max(len(interesting_ports), 1)
+
+    # -- predicate / affinity groups ----------------------------------------
+    t_groups: dict[tuple, int] = {}
+    task_gid = np.zeros(T, np.int32)
+    t_reps: list[TaskInfo] = []
+    for i, t in enumerate(task_list):
+        sig = _task_signature(t)
+        if sig not in t_groups:
+            t_groups[sig] = len(t_reps)
+            t_reps.append(t)
+        task_gid[i] = t_groups[sig]
+    n_groups: dict[tuple, int] = {}
+    node_gid = np.zeros(N, np.int32)
+    n_reps: list[NodeInfo] = []
+    for i, n in enumerate(node_list):
+        sig = _node_signature(n)
+        if sig not in n_groups:
+            n_groups[sig] = len(n_reps)
+            n_reps.append(n)
+        node_gid[i] = n_groups[sig]
+    GT, GN = max(len(t_reps), 1), max(len(n_reps), 1)
+    compat = np.zeros((GT, GN), bool)
+    aff_sc = np.zeros((GT, GN), dtype)
+    for gi, trep in enumerate(t_reps):
+        for gj, nrep in enumerate(n_reps):
+            if nrep.node is None:
+                continue  # predicates.py: no node object -> reject
+            compat[gi, gj] = (
+                check_node_unschedulable(trep.pod, nrep.node)
+                and check_node_selector(trep.pod, nrep.node)
+                and check_taints(trep.pod, nrep.node)
+            )
+            aff_sc[gi, gj] = node_affinity_score(trep, nrep)
+
+    # -- task arrays ---------------------------------------------------------
+    task_req = np.zeros((T, R), dtype)
+    task_res = np.zeros((T, R), dtype)
+    task_job = np.zeros(T, np.int32)
+    task_rank = np.zeros(T, np.int32)
+    task_has_sc = np.zeros(T, bool)
+    task_ports = np.zeros((T, P), bool)
+    task_valid = np.zeros(T, bool)
+    for i, t in enumerate(task_list):
+        task_req[i] = t.init_resreq.to_vector(scalar_names)
+        task_res[i] = t.resreq.to_vector(scalar_names)
+        task_job[i] = job_idx[t.job]
+        task_rank[i] = i  # already sorted within job; globally unique
+        task_has_sc[i] = bool(t.init_resreq.scalars)
+        task_valid[i] = True
+        for p in _task_ports(t):
+            task_ports[i, port_idx[p]] = True
+
+    # -- node arrays ---------------------------------------------------------
+    node_idle = np.zeros((N, R), dtype)
+    node_rel = np.zeros((N, R), dtype)
+    node_used = np.zeros((N, R), dtype)
+    node_alloc = np.zeros((N, R), dtype)
+    node_ok = np.zeros(N, bool)
+    node_valid = np.zeros(N, bool)
+    node_max_tasks = np.zeros(N, np.int32)
+    node_ntasks = np.zeros(N, np.int32)
+    node_idle_has_sc = np.zeros(N, bool)
+    node_rel_has_sc = np.zeros(N, bool)
+    node_ports = np.zeros((N, P), bool)
+    for i, n in enumerate(node_list):
+        node_idle[i] = n.idle.to_vector(scalar_names)
+        node_rel[i] = n.releasing.to_vector(scalar_names)
+        node_used[i] = n.used.to_vector(scalar_names)
+        node_alloc[i] = n.allocatable.to_vector(scalar_names)
+        node_ok[i] = (
+            n.node is not None
+            and check_node_condition(n.node)
+            and check_pressure(n.node)
+        )
+        node_valid[i] = True
+        node_max_tasks[i] = n.allocatable.max_task_num
+        node_ntasks[i] = len(n.tasks)
+        node_idle_has_sc[i] = bool(n.idle.scalars)
+        node_rel_has_sc[i] = bool(n.releasing.scalars)
+        for task in n.tasks.values():
+            for p in _task_ports(task):
+                if p in port_idx:
+                    node_ports[i, port_idx[p]] = True
+
+    # -- job / queue arrays --------------------------------------------------
+    job_min = np.zeros(J, np.int32)
+    job_ready0 = np.zeros(J, np.int32)
+    job_prio = np.zeros(J, np.int32)
+    job_rank = np.zeros(J, np.int32)
+    job_queue = np.zeros(J, np.int32)
+    job_valid = np.zeros(J, bool)
+    for i, j in enumerate(job_list):
+        job_min[i] = j.min_available
+        job_ready0[i] = j.ready_task_num()
+        job_prio[i] = j.priority
+        job_rank[i] = i  # job_list pre-sorted by (creation, uid)
+        job_queue[i] = queue_idx[j.queue]
+        job_valid[i] = True
+    queue_rank = np.arange(Q, dtype=np.int32)  # queue_list pre-sorted
+
+    eps = np.asarray(Resource.vector_epsilons(scalar_names), dtype)
+
+    return EncodedSnapshot(
+        scalar_names=scalar_names,
+        tasks=task_list,
+        jobs=job_list,
+        queues=queue_list,
+        node_names=[n.name for n in node_list],
+        n_tasks=t_n,
+        n_nodes=n_n,
+        n_jobs=j_n,
+        n_queues=q_n,
+        host_only=host_only,
+        arrays=dict(
+            task_req=task_req,
+            task_res=task_res,
+            task_job=task_job,
+            task_rank=task_rank,
+            task_gid=task_gid,
+            task_has_sc=task_has_sc,
+            task_ports=task_ports,
+            task_valid=task_valid,
+            node_idle=node_idle,
+            node_rel=node_rel,
+            node_used=node_used,
+            node_alloc=node_alloc,
+            node_ok=node_ok,
+            node_valid=node_valid,
+            node_max_tasks=node_max_tasks,
+            node_ntasks=node_ntasks,
+            node_idle_has_sc=node_idle_has_sc,
+            node_rel_has_sc=node_rel_has_sc,
+            node_gid=node_gid,
+            node_ports=node_ports,
+            compat=compat,
+            aff_sc=aff_sc,
+            job_min=job_min,
+            job_ready0=job_ready0,
+            job_prio=job_prio,
+            job_rank=job_rank,
+            job_queue=job_queue,
+            job_valid=job_valid,
+            queue_rank=queue_rank,
+            eps=eps,
+        ),
+    )

@@ -1,0 +1,254 @@
+"""Jitted gang-aware allocation solve (the vectorized allocate action).
+
+One `lax.while_loop` iteration = one step of the serial allocate loop
+(reference actions/allocate/allocate.go:94-190): select the active queue
+(static creation/uid rank, session_plugins.go:280-305), select the next
+job from it (priority desc -> gang non-ready-first -> creation/uid,
+priority.go:61-77 + gang.go:96-118 + session fallback), pop its next
+pending task (priority desc -> creation/uid), and assign it to the best
+feasible node — except that the per-task predicate scan (HOT LOOP #1,
+scheduler_helper.go:34-57) and the scoring scan (HOT LOOP #2,
+scheduler_helper.go:60-109) are single vectorized ops over the whole node
+axis instead of a 16-goroutine fan-out:
+
+- feasibility: epsilon-tolerant resource fit against idle OR releasing
+  (allocate.go:78-92 + resource_info.go:255-278, including the Go
+  nil-scalar-map parity flags), precomputed label-compat gather
+  (selector/taints/cordon), pod-count room, dynamic host-port bitmask;
+- score: LeastRequested + BalancedResourceAllocation integer formulas
+  plus the precomputed preferred-node-affinity term (nodeorder.go:109-222),
+  argmax with first-node tie-break (= deterministic SelectBestNode);
+- assignment: fits-idle -> allocate (consume idle, ready_count++), else
+  -> pipeline onto releasing (node_info.go:108-136 accounting), with the
+  gang barrier — a job reaching min_available is re-queued so other jobs
+  get their turn, exactly like the serial heap re-push (allocate.go:182-185).
+
+Each iteration retires one task or one job, so the loop runs at most
+T + J + 1 iterations; every iteration is O(T + J + N*R) of pure vector
+work (VPU-friendly compares/selects; the N*R fit/score block is the MXU/
+VPU payload). All shapes are static (encode.py pads to buckets).
+
+The kernel is policy-exact for conf `priority, gang, predicates,
+nodeorder` (minus pairwise pod-affinity, which stays host-side — see
+encode.host_only). drf / proportion session-event bookkeeping folds into
+the loop state in a later revision (SURVEY.md section 7 hard part (d)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAX_PRIORITY = 10  # schedulerapi.MaxPriority (nodeorder.py)
+
+KIND_NONE = 0
+KIND_ALLOCATED = 1
+KIND_PIPELINED = 2
+
+
+class SolveResult(NamedTuple):
+    assigned_node: jax.Array  # [T] int32, node row or -1
+    assigned_kind: jax.Array  # [T] int32, KIND_*
+    assign_pos: jax.Array  # [T] int32, order of the assignment event, or -1
+    ready_cnt: jax.Array  # [J] int32, final ready_task_num per job
+    n_assigned: jax.Array  # int32
+
+
+def _lex_argmin(mask, *keys):
+    """Index of the mask=True element minimizing keys lexicographically;
+    first index wins ties (ties cannot survive a unique final key).
+    Returns (index, any) — index is garbage when any is False."""
+    m = mask
+    for k in keys:
+        kmin = jnp.min(jnp.where(m, k, jnp.iinfo(k.dtype).max))
+        m = m & (k == kmin)
+    return jnp.argmax(m), jnp.any(mask)
+
+
+def _le_eps(req, pool, eps):
+    """Vectorized Resource.less_equal over the node axis
+    (resource_info.go:255-278): per-dimension l < r + eps."""
+    return jnp.all(req[None, :] < pool + eps[None, :], axis=1)
+
+
+def solve_allocate_step(a: dict) -> SolveResult:
+    """The full allocate solve; call through `solve_allocate` (jitted)."""
+    T = a["task_req"].shape[0]
+    N = a["node_idle"].shape[0]
+    J = a["job_min"].shape[0]
+    Q = a["queue_rank"].shape[0]
+
+    task_req = a["task_req"]
+    task_res = a["task_res"]
+    task_job = a["task_job"]
+    task_rank = a["task_rank"]
+    task_gid = a["task_gid"]
+    task_has_sc = a["task_has_sc"]
+    task_ports = a["task_ports"]
+    node_alloc = a["node_alloc"]
+    node_ok = a["node_ok"] & a["node_valid"]
+    node_max_tasks = a["node_max_tasks"]
+    node_idle_has_sc = a["node_idle_has_sc"]
+    node_rel_has_sc = a["node_rel_has_sc"]
+    node_gid = a["node_gid"]
+    compat = a["compat"]
+    aff_sc = a["aff_sc"]
+    job_min = a["job_min"]
+    job_prio = a["job_prio"]
+    job_rank = a["job_rank"]
+    job_queue = a["job_queue"]
+    queue_rank = a["queue_rank"]
+    eps = a["eps"]
+    fdtype = task_req.dtype
+    w_least = jnp.asarray(a["w_least"], fdtype)
+    w_balanced = jnp.asarray(a["w_balanced"], fdtype)
+    w_aff = jnp.asarray(a["w_aff"], fdtype)
+
+    max_iter = jnp.int32(T + J + 1)
+
+    state = dict(
+        it=jnp.int32(0),
+        step=jnp.int32(0),
+        cur=jnp.int32(-1),
+        remaining=a["task_valid"],
+        assigned_node=jnp.full(T, -1, jnp.int32),
+        assigned_kind=jnp.zeros(T, jnp.int32),
+        assign_pos=jnp.full(T, -1, jnp.int32),
+        idle=a["node_idle"],
+        rel=a["node_rel"],
+        used=a["node_used"],
+        ntasks=a["node_ntasks"],
+        nports=a["node_ports"],
+        ready_cnt=a["job_ready0"],
+        job_active=a["job_valid"],
+    )
+
+    def cond(s):
+        return ((s["cur"] >= 0) | jnp.any(s["job_active"])) & (s["it"] < max_iter)
+
+    def body(s):
+        # -- queue + job selection (only bites when no current job) ---------
+        q_has = (
+            jnp.zeros(Q, jnp.int32)
+            .at[job_queue]
+            .max(s["job_active"].astype(jnp.int32))
+        )
+        qsel, _ = _lex_argmin(q_has > 0, queue_rank)
+        ready_bit = (s["ready_cnt"] >= job_min).astype(jnp.int32)
+        jmask = s["job_active"] & (job_queue == qsel)
+        jsel, j_any = _lex_argmin(jmask, -job_prio, ready_bit, job_rank)
+        cur = jnp.where(
+            s["cur"] < 0, jnp.where(j_any, jsel.astype(jnp.int32), -1), s["cur"]
+        )
+        cur_c = jnp.maximum(cur, 0)
+
+        # -- pop the job's next pending task --------------------------------
+        tmask = s["remaining"] & (task_job == cur) & (cur >= 0)
+        t, t_any = _lex_argmin(tmask, task_rank)
+        drop = (cur >= 0) & ~t_any  # tasks exhausted -> job leaves the heap
+        proc = (cur >= 0) & t_any
+
+        # -- feasibility over the node axis (HOT LOOP #1, vectorized) -------
+        req = task_req[t]
+        fits_idle = _le_eps(req, s["idle"], eps) & ~(
+            task_has_sc[t] & ~node_idle_has_sc
+        )
+        fits_rel = _le_eps(req, s["rel"], eps) & ~(
+            task_has_sc[t] & ~node_rel_has_sc
+        )
+        static_ok = node_ok & compat[task_gid[t], node_gid]
+        room = s["ntasks"] < node_max_tasks
+        port_ok = ~jnp.any(task_ports[t][None, :] & s["nports"], axis=1)
+        cand = static_ok & room & port_ok & (fits_idle | fits_rel)
+        any_cand = jnp.any(cand)
+        abandon = proc & ~any_cand  # serial `break` without re-push
+
+        # -- score (HOT LOOP #2, vectorized) + deterministic best node ------
+        res = task_res[t]
+        req_cpu = s["used"][:, 0] + res[0]
+        req_mem = s["used"][:, 1] + res[1]
+        cap_cpu = node_alloc[:, 0]
+        cap_mem = node_alloc[:, 1]
+
+        def least_dim(rq, cp):
+            safe = jnp.where(cp == 0, 1.0, cp)
+            sc = jnp.floor((cp - rq) * MAX_PRIORITY / safe).astype(jnp.int32)
+            return jnp.where((cp == 0) | (rq > cp), 0, sc)
+
+        least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
+        cpu_f = jnp.where(cap_cpu != 0, req_cpu / jnp.where(cap_cpu == 0, 1.0, cap_cpu), 1.0)
+        mem_f = jnp.where(cap_mem != 0, req_mem / jnp.where(cap_mem == 0, 1.0, cap_mem), 1.0)
+        balanced = jnp.where(
+            (cpu_f >= 1.0) | (mem_f >= 1.0),
+            0,
+            (MAX_PRIORITY - jnp.abs(cpu_f - mem_f) * MAX_PRIORITY).astype(jnp.int32),
+        )
+        score = (
+            least.astype(fdtype) * w_least
+            + balanced.astype(fdtype) * w_balanced
+            + aff_sc[task_gid[t], node_gid] * w_aff
+        )
+        nb = jnp.argmax(jnp.where(cand, score, -jnp.inf)).astype(jnp.int32)
+
+        assign = proc & any_cand
+        do_alloc = assign & fits_idle[nb]
+        do_pipe = assign & ~fits_idle[nb]  # predicate guarantees fits_rel
+
+        # -- apply the assignment (node_info.go:108-136 accounting) ---------
+        zero_row = jnp.zeros_like(res)
+        idle = s["idle"].at[nb].add(jnp.where(do_alloc, -res, zero_row))
+        rel = s["rel"].at[nb].add(jnp.where(do_pipe, -res, zero_row))
+        used = s["used"].at[nb].add(jnp.where(assign, res, zero_row))
+        ntasks = s["ntasks"].at[nb].add(jnp.where(assign, 1, 0))
+        nports = s["nports"].at[nb].set(s["nports"][nb] | (task_ports[t] & assign))
+        ready_cnt = s["ready_cnt"].at[cur_c].add(jnp.where(do_alloc, 1, 0))
+        remaining = s["remaining"].at[t].set(jnp.where(proc, False, s["remaining"][t]))
+        assigned_node = s["assigned_node"].at[t].set(
+            jnp.where(assign, nb, s["assigned_node"][t])
+        )
+        kind = jnp.where(do_alloc, KIND_ALLOCATED, jnp.where(do_pipe, KIND_PIPELINED, 0))
+        assigned_kind = s["assigned_kind"].at[t].set(
+            jnp.where(assign, kind, s["assigned_kind"][t])
+        )
+        assign_pos = s["assign_pos"].at[t].set(
+            jnp.where(assign, s["step"], s["assign_pos"][t])
+        )
+
+        # -- gang barrier / job lifecycle (allocate.go:117-119,182-185) -----
+        job_active = s["job_active"].at[cur_c].set(
+            jnp.where(drop | abandon, False, s["job_active"][cur_c])
+        )
+        ready_now = ready_cnt[cur_c] >= job_min[cur_c]
+        cur_next = jnp.where(drop | abandon | (proc & ready_now), -1, cur)
+
+        return dict(
+            it=s["it"] + 1,
+            step=s["step"] + assign.astype(jnp.int32),
+            cur=cur_next,
+            remaining=remaining,
+            assigned_node=assigned_node,
+            assigned_kind=assigned_kind,
+            assign_pos=assign_pos,
+            idle=idle,
+            rel=rel,
+            used=used,
+            ntasks=ntasks,
+            nports=nports,
+            ready_cnt=ready_cnt,
+            job_active=job_active,
+        )
+
+    final = lax.while_loop(cond, body, state)
+    return SolveResult(
+        assigned_node=final["assigned_node"],
+        assigned_kind=final["assigned_kind"],
+        assign_pos=final["assign_pos"],
+        ready_cnt=final["ready_cnt"],
+        n_assigned=final["step"],
+    )
+
+
+solve_allocate = jax.jit(solve_allocate_step)

@@ -1,17 +1,32 @@
-"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
-sharding tests run anywhere (the driver separately dry-runs the multichip
-path). Must run before the first ``import jax`` anywhere in the test
-process."""
+"""Test configuration: force a deterministic 8-device virtual CPU mesh so
+multi-chip sharding tests run anywhere (the driver separately dry-runs the
+multichip path).
+
+The ambient environment may already have imported JAX pointed at real TPU
+hardware (an axon sitecustomize sets JAX_PLATFORMS=axon and imports jax at
+interpreter start), so env vars are too late — use jax.config.update:
+
+- platform cpu: the serial ≡ XLA equivalence tests need deterministic
+  IEEE arithmetic; TPU f32 division is approximate and can flip floor/tie
+  boundaries against the serial python path;
+- x64: float64 arrays make the XLA path bit-identical to the serial
+  float64 path. The TPU bench path runs float32, which is exact for
+  milli/MiB-granular quantities (see ops/encode.py).
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must precede the first CPU-backend initialization.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
