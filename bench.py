@@ -1,0 +1,127 @@
+"""Benchmark: serial reference path vs the XLA allocate solve.
+
+Methodology follows the reference's kubemark density tests
+(test/e2e/benchmark.go:49-281) but hollow-state in-process: generate a
+synthetic cluster (kube_batch_tpu.models), open a session, schedule one
+full cycle, measure wall-clock. The serial python path is timed on the
+1k x 100 config (it is the reference implementation, and minutes-slow
+beyond that); the XLA path is timed on the 10k x 1k multi-queue config
+(and 50k x 5k with BENCH_FULL=1).
+
+Prints ONE JSON line:
+  {"metric": "xla_pods_per_sec_10k_1k", "value": <pods/s>, "unit":
+   "pods/s", "vs_baseline": <xla per-pod rate / serial per-pod rate>}
+
+vs_baseline > 1 means the vectorized TPU path schedules pods faster than
+the serial reference path (BASELINE.md publishes no reference numbers, so
+the serial twin measured on identical hollow state is the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import kube_batch_tpu.actions  # noqa: F401
+import kube_batch_tpu.plugins  # noqa: F401
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.models import multi_queue, preempt_mix, synthetic
+from kube_batch_tpu.ops.encode import encode_session
+from kube_batch_tpu.ops.kernels import solve_allocate
+from kube_batch_tpu.testing import FakeCache
+
+TIERS_YAML = """
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def tiers():
+    return parse_scheduler_conf(TIERS_YAML).tiers
+
+
+def time_serial(cluster) -> tuple[float, int]:
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, tiers())
+    t0 = time.perf_counter()
+    get_action("allocate").execute(ssn)
+    dt = time.perf_counter() - t0
+    n = len(cache.binder.binds)
+    close_session(ssn)
+    return dt, n
+
+
+def time_xla_solve(cluster, warm: bool = True) -> tuple[float, int, float]:
+    """(solve_seconds, assigned, encode_seconds). Times the pure device
+    solve (the per-cycle hot loop); compile is cached across cycles at
+    stable bucket sizes, so the first call is excluded when warm."""
+    ssn = open_session(FakeCache(cluster), tiers())
+    t0 = time.perf_counter()
+    enc = encode_session(ssn.jobs, ssn.nodes, ssn.queues, dtype=np.float32)
+    t_encode = time.perf_counter() - t0
+    arrays = dict(enc.arrays)
+    arrays.update(
+        w_least=np.float32(1), w_balanced=np.float32(1), w_aff=np.float32(1)
+    )
+    if warm:
+        solve_allocate(arrays).n_assigned.block_until_ready()
+    t0 = time.perf_counter()
+    result = solve_allocate(arrays)
+    n = int(result.n_assigned)
+    dt = time.perf_counter() - t0
+    return dt, n, t_encode
+
+
+def main() -> None:
+    details = {}
+
+    serial_dt, serial_n = time_serial(synthetic(1000, 100))
+    serial_rate = serial_n / serial_dt if serial_dt > 0 else 0.0
+    details["serial_1k_100"] = {"s": round(serial_dt, 4), "pods": serial_n}
+
+    xs_dt, xs_n, _ = time_xla_solve(synthetic(1000, 100))
+    details["xla_1k_100"] = {"s": round(xs_dt, 4), "pods": xs_n}
+
+    xla_dt, xla_n, enc_dt = time_xla_solve(multi_queue(10_000, 1000))
+    xla_rate = xla_n / xla_dt if xla_dt > 0 else 0.0
+    details["xla_10k_1k"] = {
+        "s": round(xla_dt, 4),
+        "pods": xla_n,
+        "encode_s": round(enc_dt, 4),
+    }
+
+    if os.environ.get("BENCH_FULL"):
+        f_dt, f_n, f_enc = time_xla_solve(preempt_mix(50_000, 5000))
+        details["xla_50k_5k"] = {
+            "s": round(f_dt, 4),
+            "pods": f_n,
+            "encode_s": round(f_enc, 4),
+        }
+
+    print(json.dumps({"details": details}), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "xla_pods_per_sec_10k_1k",
+                "value": round(xla_rate, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(xla_rate / serial_rate, 2) if serial_rate else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Node-axis sharding of the allocate solve over a device mesh.
+
+The encoded snapshot's node-axis arrays are partitioned across the mesh's
+``nodes`` axis (task/job/queue state is small and replicated); the jitted
+while-loop kernel then runs SPMD: each device evaluates feasibility and
+scores for its node block, GSPMD reduces the argmax across blocks and
+broadcasts the winning assignment's capacity update. Static shapes are
+guaranteed by encode.py's power-of-two padding, so any mesh size that
+divides the node bucket (8 >= any pow2 mesh) shards cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kube_batch_tpu.ops.kernels import SolveResult, solve_allocate_step
+
+# Arrays carrying the node dimension first (see ops/encode.py).
+NODE_AXIS_ARRAYS = frozenset(
+    {
+        "node_idle",
+        "node_rel",
+        "node_used",
+        "node_alloc",
+        "node_ok",
+        "node_valid",
+        "node_max_tasks",
+        "node_ntasks",
+        "node_idle_has_sc",
+        "node_rel_has_sc",
+        "node_gid",
+        "node_ports",
+    }
+)
+
+AXIS_NAME = "nodes"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = AXIS_NAME,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """1-D device mesh over the node axis. Defaults to every visible
+    device (ICI within a slice; DCN across slices is the same mesh with
+    more devices — XLA picks the transport)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def node_shardings(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME) -> dict:
+    """PartitionSpec per array: node-axis arrays sharded, rest replicated."""
+    return {
+        k: NamedSharding(mesh, P(axis_name) if k in NODE_AXIS_ARRAYS else P())
+        for k in arrays
+    }
+
+
+def sharded_solve_allocate(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME) -> SolveResult:
+    """Run the allocate solve with the node axis sharded over ``mesh``.
+
+    The result arrays (task-axis) come back replicated. jit caches per
+    (mesh, shapes), so repeated cycles at stable bucket sizes reuse the
+    compiled SPMD program.
+    """
+    n = mesh.devices.size
+    n_nodes = arrays["node_idle"].shape[0]
+    if n_nodes % n != 0:
+        raise ValueError(
+            f"node bucket {n_nodes} not divisible by mesh size {n}; "
+            "encode with pad=True (power-of-two buckets)"
+        )
+    shardings = node_shardings(arrays, mesh, axis_name)
+    fn = jax.jit(solve_allocate_step, in_shardings=(shardings,))
+    return fn(arrays)
