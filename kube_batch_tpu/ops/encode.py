@@ -7,23 +7,39 @@ arrays that `kernels.solve_allocate` consumes in one jitted program:
 - resource rows follow the `Resource.to_vector` contract
   ``[milli_cpu, memory, *scalar_slots]`` with the per-slot epsilon vector
   (api/resource_info.py);
+- tasks are laid out **contiguously per job** in serial pop order
+  (priority desc -> creation -> uid within the job;
+  session_plugins.go:329-341), jobs in serial fallback order
+  (creation -> uid), so the kernel pops a job's next task with one
+  pointer increment instead of an O(T) masked argmin (`job_start` /
+  `job_end` delimit each job's rows);
 - the label-world predicates (node selector, required node affinity,
   taints/tolerations, cordon) and the preferred-node-affinity score are
   **deduplicated into (task-group x node-group) matrices**: tasks sharing
   a pod spec signature and nodes sharing a label/taint signature hit the
   same pure check functions (plugins/predicates.py, plugins/nodeorder.py)
   exactly once per group pair, then broadcast by integer gather on device.
-  A 10k-task job is one group, so encoding is O(T + N + GT*GN), not O(T*N);
+  A 10k-task job is one group, so encoding is O(T + N + GT*GN), not
+  O(T*N). Node signatures keep only the label keys actually referenced by
+  pending tasks' selectors/affinity terms — a cluster whose nodes all
+  carry unique labels (kubernetes.io/hostname) still collapses to a
+  handful of groups (round-2 advisor finding);
 - host ports become a small boolean incidence over the distinct ports
   pending tasks actually use, so conflicts with both residents and
   newly-assigned tasks are dynamic bitmask tests in the kernel;
+- drf / proportion session state is lifted straight from the plugin
+  instances (per-job allocated vectors + cluster totals, per-queue
+  allocated / water-filled deserved + the Go nil-scalar-map parity bits)
+  so the kernel's in-loop share updates start bit-identical to the serial
+  plugins' event-handler state (drf.go:60-83, proportion.go:58-144);
 - everything is padded to power-of-two buckets (static shapes for XLA,
   SURVEY.md section 7 hard part (e)) with validity masks.
 
 Tasks using required pod (anti-)affinity are flagged ``host_only``: that
 predicate is pairwise-dynamic over resident pods (reference
-predicates.go:187-199) and stays on the serial path (actions/xla_allocate
-falls back for such snapshots).
+predicates.go:187-199). The kernel pauses when such a task reaches the
+head of its job and the action serial-steps it (segmented hybrid,
+actions/xla_allocate).
 
 Dtype: float64 arrays make the XLA path bit-identical to the serial
 float64 Python path (the equivalence property tests run this way on CPU);
@@ -71,12 +87,29 @@ def _task_signature(task: TaskInfo) -> tuple:
     )
 
 
-def _node_signature(node: NodeInfo) -> tuple:
+def _referenced_label_keys(tasks: Sequence[TaskInfo]) -> frozenset[str]:
+    """Label keys the pending tasks' selectors / node-affinity terms can
+    actually read. Node signatures project labels onto this set so
+    per-node unique labels (hostname et al) do not defeat node-group
+    deduplication (ADVICE r2: encode.py finding)."""
+    keys: set[str] = set()
+    for t in tasks:
+        keys.update(t.pod.node_selector)
+        aff = t.pod.affinity
+        if aff is not None:
+            for term in aff.node_affinity_required:
+                keys.add(term.key)
+            for _, term in aff.node_affinity_preferred:
+                keys.add(term.key)
+    return frozenset(keys)
+
+
+def _node_signature(node: NodeInfo, label_keys: frozenset[str]) -> tuple:
     n = node.node
     if n is None:
         return (None,)
     return (
-        tuple(sorted(n.labels.items())),
+        tuple(sorted((k, v) for k, v in n.labels.items() if k in label_keys)),
         tuple(sorted(repr(t) for t in n.taints)),
         bool(n.unschedulable),
     )
@@ -92,7 +125,7 @@ class EncodedSnapshot:
     kernel's assignment back into session mutations."""
 
     scalar_names: tuple[str, ...]
-    tasks: list[TaskInfo]  # row order
+    tasks: list[TaskInfo]  # row order (contiguous per job)
     jobs: list[JobInfo]  # row order
     queues: list[QueueInfo]  # row order
     node_names: list[str]  # row order (sorted, = utils.get_node_list order)
@@ -123,12 +156,22 @@ def _collect_scalar_names(
     return tuple(sorted(names))
 
 
+def _dims_mask(res: Resource, scalar_names: Sequence[str]) -> list[bool]:
+    """Which vector slots `res.resource_names()` would iterate: cpu and
+    memory always, scalar slots only when the key is present in the
+    scalar map (share()/LessEqual walk map keys — Go nil/absent-key
+    semantics, resource_info.go:255-278, helpers.go:43-60)."""
+    return [True, True, *(n in res.scalars for n in scalar_names)]
+
+
 def encode_session(
     jobs: dict[str, JobInfo],
     nodes: dict[str, NodeInfo],
     queues: dict[str, QueueInfo],
     dtype=np.float64,
     pad: bool = True,
+    drf=None,
+    proportion=None,
 ) -> EncodedSnapshot:
     """Build the SoA snapshot for one allocate solve.
 
@@ -136,6 +179,11 @@ def encode_session(
     (reference allocate.go:48-70,120-125): Pending-phase PodGroups wait
     for enqueue, jobs of unknown queues are skipped, BestEffort
     (empty-resreq) tasks are backfill's business.
+
+    ``drf`` / ``proportion`` are the session's live plugin instances (or
+    None when the conf does not enable them); their open-session state is
+    copied verbatim so kernel share arithmetic starts from the exact
+    serial floats.
     """
     node_list = [nodes[name] for name in sorted(nodes)]
     queue_list = sorted(
@@ -159,13 +207,16 @@ def encode_session(
             continue
         job_list.append(job)
         job_pending[job.uid] = pending
-    # Stable row order for reproducibility (selection order is decided by
-    # the rank arrays below, not row order).
+    # Stable row order = the serial job heap's fallback order (creation,
+    # uid). Dynamic ordering (priority/ready/drf share) is decided by the
+    # kernel's selection keys, with this row order as the final key.
     job_list.sort(key=lambda j: (j.creation_timestamp, j.uid))
     job_idx = {j.uid: i for i, j in enumerate(job_list)}
 
     task_list: list[TaskInfo] = []
     host_only: list[TaskInfo] = []
+    job_ranges: list[tuple[int, int]] = []
+    host_only_rows: list[int] = []
     for job in job_list:
         pending = job_pending[job.uid]
         # Within-job pop order = priority desc, creation, uid (priority
@@ -173,11 +224,14 @@ def encode_session(
         pending.sort(
             key=lambda t: (-t.priority, t.pod.metadata.creation_timestamp, t.uid)
         )
+        start = len(task_list)
         for t in pending:
             aff = t.pod.affinity
             if aff is not None and (aff.pod_affinity_required or aff.pod_anti_affinity_required):
                 host_only.append(t)
+                host_only_rows.append(len(task_list))
             task_list.append(t)
+        job_ranges.append((start, len(task_list)))
 
     scalar_names = _collect_scalar_names(task_list, node_list)
     R = 2 + len(scalar_names)
@@ -193,6 +247,7 @@ def encode_session(
     P = max(len(interesting_ports), 1)
 
     # -- predicate / affinity groups ----------------------------------------
+    label_keys = _referenced_label_keys(task_list)
     t_groups: dict[tuple, int] = {}
     task_gid = np.zeros(T, np.int32)
     t_reps: list[TaskInfo] = []
@@ -206,7 +261,7 @@ def encode_session(
     node_gid = np.zeros(N, np.int32)
     n_reps: list[NodeInfo] = []
     for i, n in enumerate(node_list):
-        sig = _node_signature(n)
+        sig = _node_signature(n, label_keys)
         if sig not in n_groups:
             n_groups[sig] = len(n_reps)
             n_reps.append(n)
@@ -229,19 +284,19 @@ def encode_session(
     task_req = np.zeros((T, R), dtype)
     task_res = np.zeros((T, R), dtype)
     task_job = np.zeros(T, np.int32)
-    task_rank = np.zeros(T, np.int32)
     task_has_sc = np.zeros(T, bool)
+    task_res_has_sc = np.zeros(T, bool)
+    task_host_only = np.zeros(T, bool)
     task_ports = np.zeros((T, P), bool)
-    task_valid = np.zeros(T, bool)
     for i, t in enumerate(task_list):
         task_req[i] = t.init_resreq.to_vector(scalar_names)
         task_res[i] = t.resreq.to_vector(scalar_names)
         task_job[i] = job_idx[t.job]
-        task_rank[i] = i  # already sorted within job; globally unique
         task_has_sc[i] = bool(t.init_resreq.scalars)
-        task_valid[i] = True
+        task_res_has_sc[i] = bool(t.resreq.scalars)
         for p in _task_ports(t):
             task_ports[i, port_idx[p]] = True
+    task_host_only[host_only_rows] = True
 
     # -- node arrays ---------------------------------------------------------
     node_idle = np.zeros((N, R), dtype)
@@ -276,6 +331,8 @@ def encode_session(
                     node_ports[i, port_idx[p]] = True
 
     # -- job / queue arrays --------------------------------------------------
+    job_start = np.zeros(J, np.int32)
+    job_end = np.zeros(J, np.int32)
     job_min = np.zeros(J, np.int32)
     job_ready0 = np.zeros(J, np.int32)
     job_prio = np.zeros(J, np.int32)
@@ -283,6 +340,7 @@ def encode_session(
     job_queue = np.zeros(J, np.int32)
     job_valid = np.zeros(J, bool)
     for i, j in enumerate(job_list):
+        job_start[i], job_end[i] = job_ranges[i]
         job_min[i] = j.min_available
         job_ready0[i] = j.ready_task_num()
         job_prio[i] = j.priority
@@ -290,6 +348,32 @@ def encode_session(
         job_queue[i] = queue_idx[j.queue]
         job_valid[i] = True
     queue_rank = np.arange(Q, dtype=np.int32)  # queue_list pre-sorted
+
+    # -- drf / proportion session state (plugin-exact floats) ---------------
+    job_alloc0 = np.zeros((J, R), dtype)
+    drf_total = np.zeros(R, dtype)
+    drf_dims = np.zeros(R, bool)
+    if drf is not None:
+        drf_total[:] = drf.total_resource.to_vector(scalar_names)
+        drf_dims[:] = _dims_mask(drf.total_resource, scalar_names)
+        for i, j in enumerate(job_list):
+            attr = drf.job_attrs.get(j.uid)
+            if attr is not None:
+                job_alloc0[i] = attr.allocated.to_vector(scalar_names)
+
+    q_alloc0 = np.zeros((Q, R), dtype)
+    q_deserved = np.zeros((Q, R), dtype)
+    q_dims = np.zeros((Q, R), bool)
+    q_alloc_has_sc0 = np.zeros(Q, bool)
+    if proportion is not None:
+        for i, q in enumerate(queue_list):
+            attr = proportion.queue_attrs.get(q.name)
+            if attr is None:
+                continue  # queue with no jobs: never selected by the kernel
+            q_alloc0[i] = attr.allocated.to_vector(scalar_names)
+            q_deserved[i] = attr.deserved.to_vector(scalar_names)
+            q_dims[i] = _dims_mask(attr.deserved, scalar_names)
+            q_alloc_has_sc0[i] = bool(attr.allocated.scalars)
 
     eps = np.asarray(Resource.vector_epsilons(scalar_names), dtype)
 
@@ -308,11 +392,11 @@ def encode_session(
             task_req=task_req,
             task_res=task_res,
             task_job=task_job,
-            task_rank=task_rank,
             task_gid=task_gid,
             task_has_sc=task_has_sc,
+            task_res_has_sc=task_res_has_sc,
+            task_host_only=task_host_only,
             task_ports=task_ports,
-            task_valid=task_valid,
             node_idle=node_idle,
             node_rel=node_rel,
             node_used=node_used,
@@ -327,6 +411,8 @@ def encode_session(
             node_ports=node_ports,
             compat=compat,
             aff_sc=aff_sc,
+            job_start=job_start,
+            job_end=job_end,
             job_min=job_min,
             job_ready0=job_ready0,
             job_prio=job_prio,
@@ -334,6 +420,13 @@ def encode_session(
             job_queue=job_queue,
             job_valid=job_valid,
             queue_rank=queue_rank,
+            job_alloc0=job_alloc0,
+            drf_total=drf_total,
+            drf_dims=drf_dims,
+            q_alloc0=q_alloc0,
+            q_deserved=q_deserved,
+            q_dims=q_dims,
+            q_alloc_has_sc0=q_alloc_has_sc0,
             eps=eps,
         ),
     )

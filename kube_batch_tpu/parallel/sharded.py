@@ -11,13 +11,14 @@ divides the node bucket (8 >= any pow2 mesh) shards cleanly.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kube_batch_tpu.ops.kernels import SolveResult, solve_allocate_step
+from kube_batch_tpu.ops.kernels import SolveResult, result_of, solve_allocate_step
 
 # Arrays carrying the node dimension first (see ops/encode.py).
 NODE_AXIS_ARRAYS = frozenset(
@@ -63,7 +64,13 @@ def node_shardings(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME) -> dict
     }
 
 
-def sharded_solve_allocate(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME) -> SolveResult:
+def sharded_solve_allocate(
+    arrays: dict,
+    mesh: Mesh,
+    axis_name: str = AXIS_NAME,
+    enable_drf: bool = False,
+    enable_proportion: bool = False,
+) -> SolveResult:
     """Run the allocate solve with the node axis sharded over ``mesh``.
 
     The result arrays (task-axis) come back replicated. jit caches per
@@ -78,5 +85,12 @@ def sharded_solve_allocate(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME)
             "encode with pad=True (power-of-two buckets)"
         )
     shardings = node_shardings(arrays, mesh, axis_name)
-    fn = jax.jit(solve_allocate_step, in_shardings=(shardings,))
-    return fn(arrays)
+    fn = jax.jit(
+        partial(
+            solve_allocate_step,
+            enable_drf=enable_drf,
+            enable_proportion=enable_proportion,
+        ),
+        in_shardings=(shardings,),
+    )
+    return result_of(fn(arrays))

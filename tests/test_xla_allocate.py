@@ -34,8 +34,8 @@ from kube_batch_tpu.testing import (
     build_resource_list,
 )
 
-# The kernel's modeled policy envelope (xla_allocate falls back to serial
-# outside it; drf/proportion get folded in by a later revision).
+# A reduced envelope without drf/proportion (exercises the kernel's
+# static-key compile variant).
 TIERS_YAML = """
 actions: "allocate"
 tiers:
@@ -47,15 +47,31 @@ tiers:
   - name: nodeorder
 """
 
+# The reference's *default* conf (util.go:31-42): drf job shares,
+# proportion queue shares + overused gate fold into the kernel loop.
+DEFAULT_TIERS_YAML = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
 
-def tiers():
-    return parse_scheduler_conf(TIERS_YAML).tiers
+
+def tiers(yaml_text=TIERS_YAML):
+    return parse_scheduler_conf(yaml_text).tiers
 
 
-def run_and_capture(action_name, cluster):
+def run_and_capture(action_name, cluster, tiers_yaml=TIERS_YAML):
     """Run one action; return ({task_uid: (status, node)}, binds)."""
     cache = FakeCache(cluster)
-    ssn = open_session(cache, tiers())
+    ssn = open_session(cache, tiers(tiers_yaml))
     get_action(action_name).execute(ssn)
     state = {}
     for job in ssn.jobs.values():
@@ -66,10 +82,10 @@ def run_and_capture(action_name, cluster):
     return state, dict(cache.binder.binds)
 
 
-def assert_equivalent(make_cluster):
+def assert_equivalent(make_cluster, tiers_yaml=TIERS_YAML):
     """Build the cluster twice (identical), run serial + XLA, compare."""
-    s_state, s_binds = run_and_capture("allocate", make_cluster())
-    x_state, x_binds = run_and_capture("xla_allocate", make_cluster())
+    s_state, s_binds = run_and_capture("allocate", make_cluster(), tiers_yaml)
+    x_state, x_binds = run_and_capture("xla_allocate", make_cluster(), tiers_yaml)
     assert x_state == s_state
     assert x_binds == s_binds
 
@@ -417,12 +433,103 @@ def gen_cluster(seed: int):
     return build_cluster(pods, nodes, pgs, queues)
 
 
-@pytest.mark.parametrize("batch", range(5))
+@pytest.mark.parametrize("batch", range(2))
 def test_property_serial_equals_xla(batch):
-    """≥100 random snapshots: serial allocate ≡ xla_allocate, assignment
-    for assignment (VERDICT round-1 item 3's done-criterion)."""
+    """Random snapshots under the reduced (no-drf/proportion) envelope:
+    serial allocate ≡ xla_allocate, assignment for assignment (VERDICT
+    round-1 item 3's done-criterion)."""
     for seed in range(batch * 24, (batch + 1) * 24):
         s_state, s_binds = run_and_capture("allocate", gen_cluster(seed))
         x_state, x_binds = run_and_capture("xla_allocate", gen_cluster(seed))
         assert x_state == s_state, f"seed {seed}: state diverged"
         assert x_binds == s_binds, f"seed {seed}: binds diverged"
+
+
+@pytest.mark.parametrize("batch", range(5))
+def test_property_default_conf_serial_equals_xla(batch):
+    """≥100 random snapshots under the reference's *default* conf
+    (drf + proportion active): the kernel's in-loop share/overused state
+    must match the serial plugins decision for decision (VERDICT r2
+    item 2's done-criterion)."""
+    for seed in range(batch * 24, (batch + 1) * 24):
+        s_state, s_binds = run_and_capture(
+            "allocate", gen_cluster(seed), DEFAULT_TIERS_YAML
+        )
+        x_state, x_binds = run_and_capture(
+            "xla_allocate", gen_cluster(seed), DEFAULT_TIERS_YAML
+        )
+        assert x_state == s_state, f"seed {seed}: state diverged"
+        assert x_binds == s_binds, f"seed {seed}: binds diverged"
+
+
+def test_proportion_overused_queue_dropped():
+    """A queue past its deserved share is skipped for the cycle
+    (proportion.go:188-199): its second job must not schedule while the
+    underserved queue drains fully — and serial ≡ XLA on the outcome."""
+
+    def mk():
+        pods, pgs = [], []
+        # qa: tiny weight, big appetite; qb: big weight.
+        for q, njobs in (("qa", 3), ("qb", 3)):
+            for j in range(njobs):
+                name = f"{q}-j{j}"
+                pgs.append(build_pod_group(name, queue=q, min_member=1))
+                pods.extend(
+                    build_pod(
+                        name=f"{name}-p{i}",
+                        group_name=name,
+                        req=build_resource_list(cpu=1, memory="1Gi"),
+                    )
+                    for i in range(2)
+                )
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=2, memory="2Gi", pods=10))
+            for i in range(3)
+        ]
+        qa = build_queue("qa", weight=1)
+        qb = build_queue("qb", weight=5)
+        qa.metadata.creation_timestamp = 0.0
+        qb.metadata.creation_timestamp = 1.0
+        return build_cluster(pods, nodes, pgs, [qa, qb])
+
+    assert_equivalent(mk, DEFAULT_TIERS_YAML)
+
+
+def test_drf_share_orders_jobs():
+    """With drf active, a job that already holds resources yields to the
+    zero-share job at equal priority — serial ≡ XLA."""
+
+    def mk():
+        fat_resident = build_pod(
+            name="fat-r0",
+            group_name="fat",
+            node_name="n0",
+            phase=PodPhase.RUNNING,
+            req=build_resource_list(cpu=2, memory="2Gi"),
+        )
+        pods = [fat_resident] + [
+            build_pod(
+                name=f"fat-p{i}",
+                group_name="fat",
+                req=build_resource_list(cpu=1, memory="1Gi"),
+            )
+            for i in range(2)
+        ] + [
+            build_pod(
+                name=f"thin-p{i}",
+                group_name="thin",
+                req=build_resource_list(cpu=1, memory="1Gi"),
+            )
+            for i in range(2)
+        ]
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=4, memory="4Gi", pods=10))
+            for i in range(2)
+        ]
+        pg_fat = build_pod_group("fat", min_member=1)
+        pg_fat.metadata.creation_timestamp = 0.0
+        pg_thin = build_pod_group("thin", min_member=1)
+        pg_thin.metadata.creation_timestamp = 1.0
+        return build_cluster(pods, nodes, [pg_fat, pg_thin], [build_queue("default")])
+
+    assert_equivalent(mk, DEFAULT_TIERS_YAML)
