@@ -35,7 +35,7 @@ from typing import Optional
 
 from kube_batch_tpu import log
 from kube_batch_tpu.api.cluster_info import ClusterInfo
-from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, job_key
+from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, job_key, pod_key
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.queue_info import QueueInfo
 from kube_batch_tpu.api.types import TaskStatus
@@ -286,6 +286,8 @@ class SchedulerCache:
         if self._writer is not None:
             return
         self._stop.clear()
+        self._err_tasks.restart()
+        self._deleted_jobs.restart()
         self._writer = ThreadPoolExecutor(max_workers=8, thread_name_prefix="kb-write")
         for name, fn in (
             ("kb-resync", self._process_resync_task),
@@ -362,7 +364,11 @@ class SchedulerCache:
                 job_err = KeyError(f"job {ti.job} not found for task {ti.namespace}/{ti.name}")
         if ti.node_name:
             node = self.nodes.get(ti.node_name)
-            if node is not None:
+            # Terminated tasks were never added to the node (_add_task
+            # guards with _is_terminated), so only remove what is
+            # actually resident — otherwise every delete/update of a
+            # Succeeded/Failed pod raises and strands the task.
+            if node is not None and pod_key(ti.pod) in node.tasks:
                 try:
                     node.remove_task(ti)
                 except KeyError as e:
@@ -459,9 +465,11 @@ class SchedulerCache:
                 return
             if (
                 old.allocatable != new.allocatable
+                or old.capacity != new.capacity
                 or old.taints != new.taints
                 or old.metadata.labels != new.metadata.labels
                 or old.unschedulable != new.unschedulable
+                or old.conditions != new.conditions
             ):
                 ni.set_node(new)
 
@@ -666,6 +674,7 @@ class SchedulerCache:
             with self._mutex:
                 if job_terminated(job):
                     self.jobs.pop(job.uid, None)
+                    self._deleted_jobs.forget(job)
                     log.V(3).infof("Job <%s> deleted from cache", job.uid)
                 else:
                     self._deleted_jobs.add_rate_limited(job)

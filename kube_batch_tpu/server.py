@@ -1,0 +1,296 @@
+"""L7: process entry — flags, metrics HTTP server, leader election
+(reference cmd/kube-batch/app/server.go:63-140 +
+cmd/kube-batch/app/options/options.go:33-90).
+
+``SchedulerServer`` assembles the full stack for one process: an
+in-process ClusterStore (the API-server stand-in), the SchedulerCache,
+the Scheduler loop on its own thread, and a ThreadingHTTPServer that
+exposes:
+
+- ``GET /metrics``   — Prometheus text exposition (promhttp.Handler
+  equivalent; serves metrics.render_prometheus_text);
+- ``GET /healthz``   — liveness;
+- ``GET /version``   — version.info();
+- ``GET /apis/v1alpha1/queues``            — list queues (CLI backend);
+- ``POST /apis/v1alpha1/queues``           — create a queue;
+- ``DELETE /apis/v1alpha1/queues/<name>``  — delete a queue.
+
+The queue endpoints are the in-process replacement for the API-server
+CRD surface the reference CLI talks to (pkg/cli/queue).
+
+HA: the reference elects a leader through a ConfigMap resource lock
+(server.go:96-137). The in-process equivalent is an OS file lock
+(``flock``) on ``--lock-file``: exactly one scheduler process per lock
+file runs the loop; the kernel releases the lock if the holder dies, so
+a standby flock-blocked on the same file takes over — the same
+single-active-scheduler guarantee, lease renewal included, without an
+API server to arbitrate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kube_batch_tpu import log, metrics, version
+from kube_batch_tpu.apis.types import ObjectMeta, Queue, QueueSpec
+from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.scheduler import Scheduler
+
+DEFAULT_SCHEDULER_NAME = "kube-batch-tpu"
+DEFAULT_SCHEDULE_PERIOD = 1.0
+DEFAULT_QUEUE = "default"
+DEFAULT_LISTEN_ADDRESS = ":8080"
+
+
+class LeaderElector:
+    """flock-based leader election (see module docstring)."""
+
+    def __init__(self, lock_file: str, identity: str) -> None:
+        self.lock_file = lock_file
+        self.identity = identity
+        self._fh = None
+
+    def acquire(self, blocking: bool = True) -> bool:
+        self._fh = open(self.lock_file, "a+")  # noqa: SIM115 - held for process life
+        flags = fcntl.LOCK_EX | (0 if blocking else fcntl.LOCK_NB)
+        try:
+            fcntl.flock(self._fh, flags)
+        except BlockingIOError:
+            self._fh.close()
+            self._fh = None
+            return False
+        self._fh.seek(0)
+        self._fh.truncate()
+        self._fh.write(self.identity)
+        self._fh.flush()
+        log.infof("became leader: %s", self.identity)
+        return True
+
+    def release(self) -> None:
+        if self._fh is not None:
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+            self._fh = None
+
+
+def _make_handler(server: "SchedulerServer"):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route http.server chatter to V(4)
+            log.V(4).infof("http: " + fmt, *args)
+
+        def _reply(self, code: int, body: str, ctype: str = "application/json") -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/metrics":
+                self._reply(
+                    200, metrics.render_prometheus_text(), "text/plain; version=0.0.4"
+                )
+            elif self.path == "/healthz":
+                self._reply(200, "ok", "text/plain")
+            elif self.path == "/version":
+                self._reply(200, "\n".join(version.info()) + "\n", "text/plain")
+            elif self.path == "/apis/v1alpha1/queues":
+                queues = [
+                    {"name": q.name, "weight": q.spec.weight}
+                    for q in server.store.list("queues")
+                ]
+                self._reply(200, json.dumps({"items": queues}))
+            else:
+                self._reply(404, json.dumps({"error": "not found"}))
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/apis/v1alpha1/queues":
+                self._reply(404, json.dumps({"error": "not found"}))
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                name = body["name"]
+                weight = int(body.get("weight", 1))
+                if weight < 1:
+                    raise ValueError("weight must be >= 1")
+            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, json.dumps({"error": str(e)}))
+                return
+            try:
+                server.store.create_queue(
+                    Queue(metadata=ObjectMeta(name=name), spec=QueueSpec(weight=weight))
+                )
+            except KeyError as e:
+                self._reply(409, json.dumps({"error": str(e)}))
+                return
+            self._reply(201, json.dumps({"name": name, "weight": weight}))
+
+        def do_DELETE(self):  # noqa: N802
+            prefix = "/apis/v1alpha1/queues/"
+            if not self.path.startswith(prefix):
+                self._reply(404, json.dumps({"error": "not found"}))
+                return
+            name = self.path[len(prefix):]
+            try:
+                server.store.delete_queue(name)
+            except KeyError as e:
+                self._reply(404, json.dumps({"error": str(e)}))
+                return
+            self._reply(200, json.dumps({"deleted": name}))
+
+    return Handler
+
+
+class SchedulerServer:
+    """One process worth of scheduler: store + cache + loop + HTTP."""
+
+    def __init__(
+        self,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        scheduler_conf: Optional[str] = None,
+        schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
+        default_queue: str = DEFAULT_QUEUE,
+        listen_address: str = DEFAULT_LISTEN_ADDRESS,
+        store: Optional[ClusterStore] = None,
+    ) -> None:
+        self.store = store or ClusterStore()
+        self.cache = SchedulerCache(
+            self.store, scheduler_name=scheduler_name, default_queue=default_queue
+        )
+        self.scheduler = Scheduler(
+            self.cache, scheduler_conf=scheduler_conf, schedule_period=schedule_period
+        )
+        host, _, port = listen_address.rpartition(":")
+        self.httpd = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), _make_handler(self)
+        )
+        self.httpd.daemon_threads = True
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def listen_port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        # Ensure the default queue exists (the reference expects an admin
+        # to create it; the in-process store bootstraps it).
+        if self.store.get("queues", self.cache.default_queue) is None:
+            self.store.create_queue(
+                Queue(metadata=ObjectMeta(name=self.cache.default_queue))
+            )
+        self._stop.clear()
+        t_http = threading.Thread(
+            target=self.httpd.serve_forever, name="kb-http", daemon=True
+        )
+        t_sched = threading.Thread(
+            target=self.scheduler.run, args=(self._stop,), name="kb-loop", daemon=True
+        )
+        t_http.start()
+        t_sched.start()
+        self._threads = [t_http, t_sched]
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.cache.stop()
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads.clear()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Flags at parity with options.go:57-78."""
+    p = argparse.ArgumentParser(
+        prog="kube-batch-tpu",
+        description="TPU-native batch scheduler (kube-batch capability parity)",
+    )
+    p.add_argument(
+        "--scheduler-name",
+        default=DEFAULT_SCHEDULER_NAME,
+        help="handle pods whose scheduler_name matches this",
+    )
+    p.add_argument(
+        "--scheduler-conf", default="", help="absolute path of the scheduler conf file"
+    )
+    p.add_argument(
+        "--schedule-period",
+        type=float,
+        default=DEFAULT_SCHEDULE_PERIOD,
+        help="seconds between scheduling cycles",
+    )
+    p.add_argument(
+        "--default-queue", default=DEFAULT_QUEUE, help="default queue for jobs"
+    )
+    p.add_argument(
+        "--listen-address",
+        default=DEFAULT_LISTEN_ADDRESS,
+        help="HTTP listen address for /metrics and the queue API",
+    )
+    p.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="acquire the lock file before running the loop (HA standby)",
+    )
+    p.add_argument(
+        "--lock-file",
+        default="",
+        help="leader-election lock file (required with --leader-elect)",
+    )
+    p.add_argument("--version", action="store_true", help="show version and quit")
+    p.add_argument("-v", type=int, default=0, help="log verbosity (glog -v)")
+    return p
+
+
+def run(argv: Optional[list[str]] = None) -> None:
+    """reference app.Run (server.go:63-140)."""
+    opt = build_parser().parse_args(argv)
+    if opt.version:
+        version.print_version_and_exit()
+    if opt.leader_elect and not opt.lock_file:
+        raise SystemExit("--lock-file must be set when --leader-elect is enabled")
+    log.set_verbosity(opt.v)
+
+    elector = None
+    if opt.leader_elect:
+        import os
+        import socket
+        import uuid
+
+        identity = f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        elector = LeaderElector(opt.lock_file, identity)
+        log.infof("waiting for leadership on %s ...", opt.lock_file)
+        elector.acquire(blocking=True)
+
+    server = SchedulerServer(
+        scheduler_name=opt.scheduler_name,
+        scheduler_conf=opt.scheduler_conf or None,
+        schedule_period=opt.schedule_period,
+        default_queue=opt.default_queue,
+        listen_address=opt.listen_address,
+    )
+    server.start()
+    log.infof(
+        "kube-batch-tpu %s serving on :%d, scheduling every %.2fs",
+        version.VERSION, server.listen_port, opt.schedule_period,
+    )
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        if elector is not None:
+            elector.release()
+
+
+if __name__ == "__main__":
+    run()

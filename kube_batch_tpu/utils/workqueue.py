@@ -122,3 +122,10 @@ class RateLimitingQueue:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+
+    def restart(self) -> None:
+        """Reopen after shut_down (queued items survive); lets an owner
+        stop() and later run() again without hot-spinning its workers
+        on a permanently shut queue."""
+        with self._cond:
+            self._shutdown = False

@@ -353,6 +353,63 @@ def test_shadow_job_member_delete_does_not_strand(store, cache):
     assert not job.tasks
 
 
+def test_terminated_pod_lifecycle_does_not_strand_task(store, cache):
+    """A Succeeded pod (never resident on the node mirror) can still be
+    updated and deleted: update keeps the task, delete GCs the job."""
+    cache.run()
+    store.create_node(build_node("n1", build_resource_list(cpu=8)))
+    pod = build_pod(name="p1", node_name="n1", phase=PodPhase.RUNNING,
+                    req=build_resource_list(cpu=2))
+    store.create_pod(pod)
+    done = build_pod(name="p1", node_name="n1", phase=PodPhase.SUCCEEDED,
+                     req=build_resource_list(cpu=2))
+    done.metadata.uid = pod.metadata.uid
+    store.update_pod(done)
+    assert cache.nodes["n1"].idle == build_resource(cpu=8)  # released
+    job = next(iter(cache.jobs.values()))
+    assert len(job.tasks) == 1  # task survives in Succeeded
+    # Another update (e.g. a condition append) must not strand it.
+    store.update_pod(done)
+    assert len(next(iter(cache.jobs.values())).tasks) == 1
+    store.delete_pod("default", "p1")
+    wait_until(lambda: not cache.jobs, what="terminated shadow job GC")
+
+
+def test_node_condition_change_reaches_mirror(store, cache):
+    """Ready/pressure flips refresh the cached Node even when nothing
+    else changed, so predicates see them next snapshot."""
+    from kube_batch_tpu.apis.types import NodeCondition
+
+    store.create_node(build_node("n1", build_resource_list(cpu=8)))
+    broken = build_node("n1", build_resource_list(cpu=8))
+    broken.conditions = [NodeCondition(type="Ready", status="False")]
+    store.update_node(broken)
+    assert not cache.nodes["n1"].node.ready()
+
+
+def test_cache_stop_then_run_resyncs_again(store):
+    """stop() then run() must leave the resync machinery live (the
+    retry queues reopen)."""
+    binder = FailingBinder(store, fail_times=1)
+    sc = SchedulerCache(store, binder=binder)
+    sc.run()
+    sc.stop()
+    sc.run()
+    try:
+        store.create_node(build_node("n1", build_resource_list(cpu=8)))
+        store.create_pod(build_pod(name="p1", req=build_resource_list(cpu=2)))
+        task = next(iter(next(iter(sc.jobs.values())).tasks.values()))
+        sc.bind(task, "n1")  # first attempt fails -> resync -> retried later
+        wait_until(lambda: binder.calls >= 1, what="first bind attempt")
+        wait_until(
+            lambda: next(iter(next(iter(sc.jobs.values())).tasks.values())).status
+            == TaskStatus.PENDING,
+            what="resync after restart",
+        )
+    finally:
+        sc.stop()
+
+
 def test_group_annotation_requires_podgroup_to_snapshot(store, cache):
     """An annotated pod whose PodGroup never arrives builds a spec-less
     job that snapshot() skips (reference cache.go:545-552)."""

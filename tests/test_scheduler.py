@@ -1,0 +1,217 @@
+"""L6/L7 integration: the scheduler loop against a live, mutating
+cluster, the HTTP surface, conf hot-reload, and leader election
+(VERDICT r2 item 4)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.server import LeaderElector, SchedulerServer
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_resource_list,
+)
+
+
+def wait_until(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def server():
+    srv = SchedulerServer(listen_address="127.0.0.1:0", schedule_period=0.05)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def http_get(server, path: str) -> tuple[int, str]:
+    url = f"http://127.0.0.1:{server.listen_port}{path}"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_loop_schedules_live_mutating_cluster(server):
+    """Pods created while the loop runs get bound over subsequent cycles
+    — the scheduler behaves as a continuously running service, not a
+    one-shot library call."""
+    store = server.store
+    for i in range(2):
+        store.create_node(
+            build_node(f"n{i}", build_resource_list(cpu=4, memory="8Gi", pods=10))
+        )
+    # Gang of 2 via the full default pipeline (enqueue flips the
+    # PodGroup Pending -> Inqueue, allocate binds).
+    store.create_pod_group(build_pod_group("job-a", min_member=2))
+    for i in range(2):
+        store.create_pod(
+            build_pod(name=f"a{i}", group_name="job-a",
+                      req=build_resource_list(cpu=1, memory="1Gi"))
+        )
+    wait_until(
+        lambda: all(p.node_name for p in store.list("pods")),
+        what="first gang bound",
+    )
+
+    # Mutate the live cluster: a second job arrives mid-flight.
+    store.create_pod_group(build_pod_group("job-b", min_member=3))
+    for i in range(3):
+        store.create_pod(
+            build_pod(name=f"b{i}", group_name="job-b",
+                      req=build_resource_list(cpu=1, memory="1Gi"))
+        )
+    wait_until(
+        lambda: all(p.node_name for p in store.list("pods")),
+        what="second gang bound in a later cycle",
+    )
+    assert len([p for p in store.list("pods") if p.node_name]) == 5
+
+
+def test_gang_larger_than_cluster_stays_pending(server):
+    store = server.store
+    store.create_node(build_node("n0", build_resource_list(cpu=2, pods=10)))
+    store.create_pod_group(build_pod_group("big", min_member=3))
+    for i in range(3):
+        store.create_pod(
+            build_pod(name=f"g{i}", group_name="big", req=build_resource_list(cpu=2))
+        )
+    time.sleep(0.3)  # several cycles
+    # Gang barrier: nothing partially bound.
+    assert all(not p.node_name for p in store.list("pods"))
+
+
+def test_metrics_endpoint_scrapes_live_latencies(server):
+    wait_until(
+        lambda: metrics.schedule_attempts.value() > 0, what="first cycle"
+    )
+    status, body = http_get(server, "/metrics")
+    assert status == 200
+    assert "kube_batch_tpu_e2e_scheduling_latency_count" in body
+    assert "kube_batch_tpu_action_scheduling_latency" in body
+    # A real nonzero e2e observation landed.
+    for line in body.splitlines():
+        if line.startswith("kube_batch_tpu_e2e_scheduling_latency_count"):
+            assert float(line.split()[-1]) > 0
+            break
+    else:
+        raise AssertionError("e2e latency family missing")
+    assert 'action="allocate"' in body
+
+
+def test_healthz_and_version(server):
+    assert http_get(server, "/healthz") == (200, "ok")
+    status, body = http_get(server, "/version")
+    assert status == 200
+    assert "API Version: v1alpha1" in body
+
+
+def test_queue_api_crud(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.listen_port}/apis/v1alpha1/queues",
+        data=json.dumps({"name": "research", "weight": 4}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 201
+    status, body = http_get(server, "/apis/v1alpha1/queues")
+    items = {q["name"]: q["weight"] for q in json.loads(body)["items"]}
+    assert items["research"] == 4
+    assert "default" in items  # bootstrapped default queue
+    # The cache mirrors it for the next session.
+    wait_until(lambda: "research" in server.cache.queues, what="queue in cache")
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.listen_port}/apis/v1alpha1/queues/research",
+        method="DELETE",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 200
+    status, body = http_get(server, "/apis/v1alpha1/queues")
+    assert "research" not in body
+
+
+def test_loop_with_xla_allocate_pipeline(tmp_path):
+    """The XLA solve runs as the conf-selected allocator inside the live
+    loop: enqueue gates, xla_allocate encodes + solves + replays."""
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text(
+        'actions: "enqueue, xla_allocate"\n'
+        "tiers:\n"
+        "- plugins:\n  - name: priority\n  - name: gang\n  - name: conformance\n"
+        "- plugins:\n  - name: drf\n  - name: predicates\n"
+        "  - name: proportion\n  - name: nodeorder\n"
+    )
+    srv = SchedulerServer(
+        listen_address="127.0.0.1:0",
+        schedule_period=0.05,
+        scheduler_conf=str(conf),
+    )
+    srv.start()
+    try:
+        for i in range(2):
+            srv.store.create_node(
+                build_node(f"n{i}", build_resource_list(cpu=4, memory="8Gi", pods=10))
+            )
+        srv.store.create_pod_group(build_pod_group("xj", min_member=3))
+        for i in range(3):
+            srv.store.create_pod(
+                build_pod(name=f"x{i}", group_name="xj",
+                          req=build_resource_list(cpu=1, memory="1Gi"))
+            )
+        wait_until(
+            lambda: all(p.node_name for p in srv.store.list("pods")),
+            timeout=60,  # first cycle pays jit compile
+            what="xla pipeline bound the gang",
+        )
+    finally:
+        srv.stop()
+
+
+def test_conf_hot_reload(tmp_path):
+    """A conf push takes effect on the next cycle without a restart."""
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text(
+        'actions: "enqueue, allocate"\n'
+        "tiers:\n- plugins:\n  - name: gang\n  - name: priority\n"
+    )
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    sched = Scheduler(cache, scheduler_conf=str(conf), schedule_period=0.05)
+    assert [a.name for a in sched.actions] == ["enqueue", "allocate"]
+    conf.write_text(
+        'actions: "enqueue, allocate, backfill"\n'
+        "tiers:\n- plugins:\n  - name: gang\n  - name: priority\n"
+    )
+    sched.run_once()
+    assert [a.name for a in sched.actions] == ["enqueue", "allocate", "backfill"]
+    # A broken conf keeps the previous good pipeline.
+    conf.write_text('actions: "no-such-action"\n')
+    sched.run_once()
+    assert [a.name for a in sched.actions] == ["enqueue", "allocate", "backfill"]
+    cache.stop()
+
+
+def test_leader_election_mutual_exclusion(tmp_path):
+    lock = str(tmp_path / "leader.lock")
+    a = LeaderElector(lock, "a")
+    b = LeaderElector(lock, "b")
+    assert a.acquire(blocking=False)
+    assert not b.acquire(blocking=False)  # standby cannot grab the lease
+    a.release()
+    assert b.acquire(blocking=False)  # failover after the leader lets go
+    b.release()
