@@ -17,8 +17,9 @@ def register_all_actions() -> None:
     # The vectorized TPU path needs jax; without it the scheduler still
     # works serially and a conf naming xla_allocate fails at load time.
     try:
-        from kube_batch_tpu.actions import xla_allocate
+        from kube_batch_tpu.actions import xla_allocate, xla_preempt
 
         register_action(xla_allocate.new())
+        register_action(xla_preempt.new())
     except ImportError:
         pass

@@ -41,6 +41,7 @@ values — and logs that it did so.
 from __future__ import annotations
 
 import logging
+import os
 
 import jax  # noqa: F401  -- fail registration, not mid-cycle, when absent
 import numpy as np
@@ -196,19 +197,17 @@ class XlaAllocateAction(Action):
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
+        solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype)
+
         t0 = _time.perf_counter()
-        state = solve_allocate_state(
-            arrays, None, enable_drf=enable_drf, enable_proportion=enable_proportion
-        )
+        state = solve_fn(None)
         while int(state.paused_at) >= 0:
             # Segmented hybrid: sync the session up to the pause point,
             # serial-step the host-only task, resume the kernel.
             s = jax.tree_util.tree_map(np.array, state)  # writable host copy
             replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
             s = self._host_step(ssn, enc, arrays, replay, s)
-            state = solve_allocate_state(
-                arrays, s, enable_drf=enable_drf, enable_proportion=enable_proportion
-            )
+            state = solve_fn(s)
 
         result = result_of(state)
         assign_pos = np.asarray(result.assign_pos)
@@ -223,6 +222,51 @@ class XlaAllocateAction(Action):
             "solve_s": t_solve,
             "replay_s": _time.perf_counter() - t0,
         }
+
+    def _make_solver(self, arrays, enable_drf: bool, enable_proportion: bool, dtype):
+        """Pick the device solve: the fused Pallas kernel on TPU-class
+        backends (float32, in-envelope snapshots), else the XLA
+        `lax.while_loop` kernel. `KBT_PALLAS=0` forces the XLA kernel;
+        `KBT_PALLAS=interpret` runs the Pallas kernel in interpreter mode
+        (CPU parity tests)."""
+        from kube_batch_tpu.ops.kernels import solve_allocate_state
+
+        mode = os.environ.get("KBT_PALLAS", "1")
+        solver = None
+        if mode != "0" and dtype == np.float32:
+            import jax as _jax
+
+            from kube_batch_tpu.ops import pallas_solve
+
+            interpret = mode == "interpret"
+            on_tpu = _jax.default_backend() == "tpu"  # Mosaic kernels are TPU-only
+            if (on_tpu or interpret) and pallas_solve.supported(arrays):
+                try:
+                    solver = pallas_solve.PallasSolver(
+                        arrays, enable_drf, enable_proportion, interpret=interpret
+                    )
+                    log.debug("solving with fused pallas kernel")
+                except Exception:
+                    log.exception("pallas solver init failed; using XLA kernel")
+                    solver = None
+
+        def solve_fn(st):
+            # Tracing/Mosaic lowering is lazy — the first solve call can
+            # still fail, so the fallback has to live here, not only at
+            # solver construction. Both solvers speak SolveState, so the
+            # XLA kernel resumes exactly from wherever pallas left off.
+            nonlocal solver
+            if solver is not None:
+                try:
+                    return solver.solve(st)
+                except Exception:
+                    log.exception("pallas solve failed; falling back to XLA kernel")
+                    solver = None
+            return solve_allocate_state(
+                arrays, st, enable_drf=enable_drf, enable_proportion=enable_proportion
+            )
+
+        return solve_fn
 
     # -- host-side serial step for one pod-affinity task ---------------------
 
@@ -337,9 +381,15 @@ class _Replayer:
         self.enc = enc
         self.arrays = arrays
         self.task_res64 = np.asarray(arrays["task_res"], np.float64)
+        self.task_job = np.asarray(arrays["task_job"])
+        self.task_res_has_sc = np.asarray(arrays["task_res_has_sc"])
+        self.job_queue = np.asarray(arrays["job_queue"])
         self.drf = ssn.plugins.get("drf") if enable_drf else None
         self.prop = ssn.plugins.get("proportion") if enable_prop else None
         self.node_idx = {name: i for i, name in enumerate(enc.node_names)}
+        # Row-indexed hot lookups for the bulk loop.
+        self.task_keys = [f"{t.namespace}/{t.name}" for t in enc.tasks]
+        self.node_by_row = [ssn.nodes[name] for name in enc.node_names]
         self.replayed = 0  # assignment events already applied
         self.alloc_jobs: set[str] = set()  # jobs with >=1 Allocated event
         # per-node aggregation buffers (flushed once per segment)
@@ -376,7 +426,7 @@ class _Replayer:
 
         # node: task map entry (a clone, node_info.go:117) + deferred sums
         node = ssn.nodes[hostname]
-        node.tasks[f"{task.namespace}/{task.name}"] = task.clone()
+        node.tasks[self.task_keys[row]] = task.clone_for_residency()
         buf = self._node_buf.get(nrow)
         if buf is None:
             buf = self._node_buf[nrow] = _NodeDelta()
@@ -406,15 +456,135 @@ class _Replayer:
         self._flush_nodes()
 
     def apply_upto(self, assign_pos, assigned_node, assigned_kind, step: int) -> None:
-        """Apply all events with replayed <= pos < step, in event order."""
+        """Apply all events with replayed <= pos < step — the same net
+        state mutations as per-event `apply_one`, but with every
+        order-independent aggregate (node idle/releasing/used, job
+        allocated, drf/proportion vectors) computed as a vectorized
+        segment sum. Exact: all quantities are integer-grid float64, so
+        addition order cannot change the sums, and scalar-map key
+        creation follows the same per-event add/sub rules via the
+        tracked key sets."""
+        from kube_batch_tpu.ops.kernels import KIND_ALLOCATED
+
         if step <= self.replayed:
             return
-        rows = np.nonzero((assign_pos >= self.replayed) & (assign_pos < step))[0]
-        rows = rows[np.argsort(assign_pos[rows], kind="stable")]
-        for row in rows:
-            self.apply_one(int(row), int(assigned_node[row]), int(assigned_kind[row]))
+        sel = (assign_pos >= self.replayed) & (assign_pos < step)
+        rows = np.nonzero(sel)[0]
         self.replayed = step
-        self._flush_nodes()
+        if rows.size == 0:
+            return
+        rows = rows[np.argsort(assign_pos[rows], kind="stable")]
+        nrows = assigned_node[rows]
+        kinds = assigned_kind[rows]
+        alloc = kinds == KIND_ALLOCATED
+        res = self.task_res64[rows]
+        tjob = self.task_job[rows]
+        scalar_names = self.enc.scalar_names
+        R = res.shape[1]
+        empty: frozenset = frozenset()
+
+        # -- scalar-key bookkeeping (only rows whose resreq has scalars) --
+        nkeys_alloc: dict[int, set] = {}
+        nkeys_pipe: dict[int, set] = {}
+        jkeys_alloc: dict[int, set] = {}
+        jkeys_all: dict[int, set] = {}
+        qkeys: dict[int, set] = {}
+        for i in np.nonzero(self.task_res_has_sc[rows])[0].tolist():
+            keys = self.enc.tasks[int(rows[i])].resreq.scalars.keys()
+            n_i, j_i = int(nrows[i]), int(tjob[i])
+            (nkeys_alloc if alloc[i] else nkeys_pipe).setdefault(n_i, set()).update(keys)
+            if alloc[i]:
+                jkeys_alloc.setdefault(j_i, set()).update(keys)
+            jkeys_all.setdefault(j_i, set()).update(keys)
+            qkeys.setdefault(int(self.job_queue[j_i]), set()).update(keys)
+
+        # -- node accounting (node_info.go:108-136 net effect) ------------
+        touched_n = np.unique(nrows)
+        compn = np.searchsorted(touched_n, nrows)
+        n_alloc_vec = np.zeros((touched_n.size, R))
+        n_pipe_vec = np.zeros((touched_n.size, R))
+        np.add.at(n_alloc_vec, compn[alloc], res[alloc])
+        np.add.at(n_pipe_vec, compn[~alloc], res[~alloc])
+        for k, nrow in enumerate(touched_n.tolist()):
+            node = self.node_by_row[nrow]
+            ka = nkeys_alloc.get(nrow, empty)
+            kp = nkeys_pipe.get(nrow, empty)
+            _res_sub(node.idle, n_alloc_vec[k], scalar_names, ka)
+            _res_sub(node.releasing, n_pipe_vec[k], scalar_names, kp)
+            _res_add(node.used, n_alloc_vec[k] + n_pipe_vec[k], scalar_names, ka | kp)
+
+        # -- job.allocated + drf/proportion event bookkeeping -------------
+        touched_j = np.unique(tjob)
+        compj = np.searchsorted(touched_j, tjob)
+        j_tot = np.zeros((touched_j.size, R))
+        j_alloc = np.zeros((touched_j.size, R))
+        np.add.at(j_tot, compj, res)
+        np.add.at(j_alloc, compj[alloc], res[alloc])
+        jobs_with_alloc = set(np.unique(tjob[alloc]).tolist())
+        drf = self.drf
+        for k, jrow in enumerate(touched_j.tolist()):
+            job = self.enc.jobs[jrow]
+            if jrow in jobs_with_alloc:
+                self.alloc_jobs.add(job.uid)
+                _res_add(job.allocated, j_alloc[k], scalar_names, jkeys_alloc.get(jrow, empty))
+            if drf is not None:
+                _res_add(
+                    drf.job_attrs[job.uid].allocated, j_tot[k], scalar_names,
+                    jkeys_all.get(jrow, empty),
+                )
+                self._touched_drf.add(job.uid)
+        prop = self.prop
+        if prop is not None:
+            qrow_arr = self.job_queue[tjob]
+            touched_q = np.unique(qrow_arr)
+            compq = np.searchsorted(touched_q, qrow_arr)
+            q_tot = np.zeros((touched_q.size, R))
+            np.add.at(q_tot, compq, res)
+            for k, qrow in enumerate(touched_q.tolist()):
+                qname = self.enc.queues[qrow].name
+                _res_add(
+                    prop.queue_attrs[qname].allocated, q_tot[k], scalar_names,
+                    qkeys.get(qrow, empty),
+                )
+                self._touched_prop.add(qname)
+
+        # -- per-task surgery (status index, node task map, volumes) ------
+        tasks = self.enc.tasks
+        tkeys = self.task_keys
+        node_by_row = self.node_by_row
+        jobs_l = self.enc.jobs
+        alloc_volumes = self.ssn.cache.allocate_volumes
+        ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
+        cur_jrow = -1
+        sidx = pend = None
+        for row, nrow, jrow, is_alloc in zip(
+            rows.tolist(), nrows.tolist(), tjob.tolist(), alloc.tolist()
+        ):
+            task = tasks[row]
+            hostname = node_by_row[nrow].name
+            if jrow != cur_jrow:
+                cur_jrow = jrow
+                sidx = jobs_l[jrow].task_status_index
+                pend = sidx.get(TaskStatus.PENDING)
+            if is_alloc:
+                alloc_volumes(task, hostname)
+                status = ALLOCATED
+            else:
+                status = PIPELINED
+            if pend is not None:
+                pend.pop(task.uid, None)
+            task.status = status
+            task.node_name = hostname
+            d = sidx.get(status)
+            if d is None:
+                d = sidx[status] = {}
+            d[task.uid] = task
+            node_by_row[nrow].tasks[tkeys[row]] = task.clone_for_residency()
+        for jrow in touched_j.tolist():
+            sidx = jobs_l[jrow].task_status_index
+            pend = sidx.get(TaskStatus.PENDING)
+            if pend is not None and not pend:
+                del sidx[TaskStatus.PENDING]
 
     def _flush_nodes(self) -> None:
         """Fold the per-node resource deltas into NodeInfo, following
@@ -448,6 +618,9 @@ class _Replayer:
 
         now = _time.time()
         job_min = self.arrays["job_min"]
+        bind_volumes = ssn.cache.bind_volumes
+        bind = ssn.cache.bind
+        durations: list[float] = []
         for i, job in enumerate(self.enc.jobs):
             if job.uid not in self.alloc_jobs:
                 continue
@@ -456,18 +629,18 @@ class _Replayer:
             allocated = job.task_status_index.get(TaskStatus.ALLOCATED)
             if not allocated:
                 continue
+            binding = job.task_status_index.setdefault(TaskStatus.BINDING, {})
             for task in list(allocated.values()):
-                ssn.cache.bind_volumes(task)
-                ssn.cache.bind(task, task.node_name)
+                bind_volumes(task)
+                bind(task, task.node_name)
                 allocated.pop(task.uid, None)
                 task.status = TaskStatus.BINDING
-                job.task_status_index.setdefault(TaskStatus.BINDING, {})[task.uid] = task
-                metrics.update_task_schedule_duration(
-                    max(0.0, now - task.pod.metadata.creation_timestamp)
-                )
+                binding[task.uid] = task
+                durations.append(max(0.0, now - task.pod.metadata.creation_timestamp))
             if not allocated:
                 job.task_status_index.pop(TaskStatus.ALLOCATED, None)
             log.debug("dispatched gang job %s (%d tasks)", job.uid, int(ready_cnt[i]))
+        metrics.update_task_schedule_durations(durations)
 
 
 class _NodeDelta:

@@ -94,6 +94,28 @@ class TaskInfo:
         ti.pod = self.pod
         return ti
 
+    def clone_for_residency(self) -> "TaskInfo":
+        """Clone that shares the Resource objects. The node task-map copy
+        (reference node_info.go:117) needs an independent *status* so later
+        caller-side status flips cannot corrupt accounting; resource values
+        are never mutated on a TaskInfo after construction (no call site
+        does — the accounting arithmetic mutates node/job aggregates only),
+        so sharing them is exact and saves two Resource copies per
+        assignment on the bulk replay path."""
+        ti = TaskInfo.__new__(TaskInfo)
+        ti.uid = self.uid
+        ti.job = self.job
+        ti.name = self.name
+        ti.namespace = self.namespace
+        ti.resreq = self.resreq
+        ti.init_resreq = self.init_resreq
+        ti.node_name = self.node_name
+        ti.status = self.status
+        ti.priority = self.priority
+        ti.volume_ready = self.volume_ready
+        ti.pod = self.pod
+        return ti
+
     def __repr__(self) -> str:
         return (
             f"Task ({self.uid}:{self.namespace}/{self.name}): job {self.job}, "

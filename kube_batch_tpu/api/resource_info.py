@@ -99,7 +99,12 @@ class Resource:
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.milli_cpu, self.memory, self.scalars, self.max_task_num)
+        r = Resource.__new__(Resource)
+        r.milli_cpu = self.milli_cpu
+        r.memory = self.memory
+        r.scalars = dict(self.scalars)
+        r.max_task_num = self.max_task_num
+        return r
 
     # -- predicates ---------------------------------------------------------
 

@@ -135,6 +135,19 @@ class Affinity:
     node_affinity_preferred: list[tuple[int, NodeSelectorTerm]] = field(default_factory=list)
     pod_affinity_required: list[PodAffinityTerm] = field(default_factory=list)
     pod_anti_affinity_required: list[PodAffinityTerm] = field(default_factory=list)
+    # preferredDuringSchedulingIgnoredDuringExecution pod (anti-)affinity:
+    # (weight, term) pairs — scored by nodeorder's InterPodAffinity
+    # priority, never gating feasibility
+    pod_affinity_preferred: list[tuple[int, PodAffinityTerm]] = field(default_factory=list)
+    pod_anti_affinity_preferred: list[tuple[int, PodAffinityTerm]] = field(default_factory=list)
+
+    def has_pod_affinity_terms(self) -> bool:
+        return bool(
+            self.pod_affinity_required
+            or self.pod_anti_affinity_required
+            or self.pod_affinity_preferred
+            or self.pod_anti_affinity_preferred
+        )
 
 
 @dataclass

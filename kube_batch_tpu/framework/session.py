@@ -52,6 +52,11 @@ class Session:
     def __init__(self, cache: Cache) -> None:
         self.uid: str = str(_uuid.uuid4())
         self.cache = cache
+        # Monotonic counter bumped by every session-state mutation
+        # (allocate/pipeline/evict and Statement do/undo ops); plugins use
+        # it to invalidate per-task caches (nodeorder's InterPodAffinity
+        # memo) without recomputing per (task, node) call.
+        self.state_seq: int = 0
 
         self.jobs: dict[str, JobInfo] = {}
         self.nodes: dict[str, NodeInfo] = {}
@@ -332,6 +337,7 @@ class Session:
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Assign onto releasing resources; session-only, no bind
         (session.go:198-238)."""
+        self.state_seq += 1
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
@@ -348,6 +354,7 @@ class Session:
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         """Allocate idle resources; dispatch the whole gang once JobReady
         (the gang barrier, session.go:241-296)."""
+        self.state_seq += 1
         self.cache.allocate_volumes(task, hostname)
         job = self.jobs.get(task.job)
         if job is None:
@@ -379,6 +386,7 @@ class Session:
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """session.go:325-362."""
+        self.state_seq += 1
         self.cache.evict(reclaimee, reason)
         job = self.jobs.get(reclaimee.job)
         if job is None:

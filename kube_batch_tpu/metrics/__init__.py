@@ -50,6 +50,29 @@ class Histogram:
                     return
             counts[-1] += 1
 
+    def observe_many(self, values, labels: Optional[dict[str, str]] = None) -> None:
+        """Batch observe: one lock acquisition for a whole list of values —
+        identical bucket counts/sum/total to calling observe per value."""
+        values = list(values)
+        if not values:
+            return
+        from bisect import bisect_left
+
+        buckets = self.buckets
+        nb = len(buckets)
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (nb + 1), 0.0, 0]
+                self._series[key] = series
+            counts = series[0]
+            for v in values:
+                i = bisect_left(buckets, v)  # first bucket with v <= bound
+                counts[i if i < nb else nb] += 1
+            series[1] += sum(values)
+            series[2] += len(values)
+
     def snapshot(self, labels: Optional[dict[str, str]] = None) -> dict:
         """Cumulative bucket counts for one label set (default: the sum
         over all label sets)."""
@@ -178,6 +201,11 @@ def update_action_duration(action: str, seconds: float) -> None:
 
 def update_task_schedule_duration(seconds: float) -> None:
     task_scheduling_latency.observe(seconds)
+
+
+def update_task_schedule_durations(seconds_list) -> None:
+    """Batch form of update_task_schedule_duration (bulk gang dispatch)."""
+    task_scheduling_latency.observe_many(seconds_list)
 
 
 def update_preemption_victims_count(count: int) -> None:

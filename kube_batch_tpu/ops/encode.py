@@ -78,8 +78,13 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return 1 << (size - 1).bit_length()
 
 
+_PLAIN_SIG = ((), "None", ())
+
+
 def _task_signature(task: TaskInfo) -> tuple:
     pod = task.pod
+    if not pod.node_selector and pod.affinity is None and not pod.tolerations:
+        return _PLAIN_SIG  # fast path: the overwhelmingly common pod shape
     return (
         tuple(sorted(pod.node_selector.items())),
         repr(pod.affinity),
@@ -115,8 +120,14 @@ def _node_signature(node: NodeInfo, label_keys: frozenset[str]) -> tuple:
     )
 
 
+_EMPTY_PORTS: frozenset[int] = frozenset()
+
+
 def _task_ports(task: TaskInfo) -> frozenset[int]:
-    return frozenset(p for c in task.pod.containers for p in c.ports)
+    cs = task.pod.containers
+    if len(cs) == 1 and not cs[0].ports:
+        return _EMPTY_PORTS  # fast path: single portless container
+    return frozenset(p for c in cs for p in c.ports)
 
 
 @dataclass
@@ -280,7 +291,8 @@ def encode_session(
             )
             aff_sc[gi, gj] = node_affinity_score(trep, nrep)
 
-    # -- task arrays ---------------------------------------------------------
+    # -- task arrays (bulk-filled: one ndarray conversion, not 50k row
+    #    assignments — encode_s is on the session critical path) -----------
     task_req = np.zeros((T, R), dtype)
     task_res = np.zeros((T, R), dtype)
     task_job = np.zeros(T, np.int32)
@@ -288,14 +300,35 @@ def encode_session(
     task_res_has_sc = np.zeros(T, bool)
     task_host_only = np.zeros(T, bool)
     task_ports = np.zeros((T, P), bool)
-    for i, t in enumerate(task_list):
-        task_req[i] = t.init_resreq.to_vector(scalar_names)
-        task_res[i] = t.resreq.to_vector(scalar_names)
-        task_job[i] = job_idx[t.job]
-        task_has_sc[i] = bool(t.init_resreq.scalars)
-        task_res_has_sc[i] = bool(t.resreq.scalars)
-        for p in _task_ports(t):
-            task_ports[i, port_idx[p]] = True
+    if t_n:
+        if scalar_names:
+            task_req[:t_n] = np.asarray(
+                [t.init_resreq.to_vector(scalar_names) for t in task_list], dtype
+            )
+            task_res[:t_n] = np.asarray(
+                [t.resreq.to_vector(scalar_names) for t in task_list], dtype
+            )
+        else:
+            task_req[:t_n] = np.asarray(
+                [(t.init_resreq.milli_cpu, t.init_resreq.memory) for t in task_list],
+                dtype,
+            )
+            task_res[:t_n] = np.asarray(
+                [(t.resreq.milli_cpu, t.resreq.memory) for t in task_list], dtype
+            )
+        task_job[:t_n] = np.fromiter(
+            (job_idx[t.job] for t in task_list), np.int32, count=t_n
+        )
+        task_has_sc[:t_n] = np.fromiter(
+            (bool(t.init_resreq.scalars) for t in task_list), bool, count=t_n
+        )
+        task_res_has_sc[:t_n] = np.fromiter(
+            (bool(t.resreq.scalars) for t in task_list), bool, count=t_n
+        )
+        if interesting_ports:
+            for i, t in enumerate(task_list):
+                for p in _task_ports(t):
+                    task_ports[i, port_idx[p]] = True
     task_host_only[host_only_rows] = True
 
     # -- node arrays ---------------------------------------------------------

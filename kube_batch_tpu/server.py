@@ -168,9 +168,9 @@ class SchedulerServer:
             self.cache, scheduler_conf=scheduler_conf, schedule_period=schedule_period
         )
         host, _, port = listen_address.rpartition(":")
-        self.httpd = ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), _make_handler(self)
-        )
+        # ":8080" means all interfaces, matching the reference's
+        # net.Listen semantics for ListenAddress (app/options/options.go)
+        self.httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _make_handler(self))
         self.httpd.daemon_threads = True
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []

@@ -1,0 +1,124 @@
+"""Pallas fused solve ≡ XLA while-loop solve.
+
+The XLA kernel (ops/kernels.py) is itself pinned against the serial
+oracle (tests/test_xla_allocate.py); these tests pin the fused Pallas
+kernel (ops/pallas_solve.py) against the XLA kernel, decision for
+decision, on the same float32 snapshots. On CPU the Pallas kernel runs
+in interpreter mode; on the real chip the compiled kernel is covered by
+bench.py's serial-vs-xla bind assertions (the action auto-selects the
+Pallas path on TPU).
+"""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions  # noqa: F401  (registers actions)
+from kube_batch_tpu import plugins  # noqa: F401  (registers plugins)
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.models import multi_tenant_ml, synthetic
+from kube_batch_tpu.ops.encode import encode_session
+from kube_batch_tpu.ops.kernels import solve_allocate_state
+from kube_batch_tpu.ops.pallas_solve import PallasSolver, supported
+from kube_batch_tpu.testing import FakeCache
+
+from test_xla_allocate import DEFAULT_TIERS_YAML, gen_cluster
+
+
+def solve_both(cluster, drf=True, proportion=True):
+    """Encode once (float32), run the XLA and interpret-mode Pallas
+    solvers on identical arrays; return both final states."""
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+    enc = encode_session(
+        ssn.jobs,
+        ssn.nodes,
+        ssn.queues,
+        dtype=np.float32,
+        drf=ssn.plugins.get("drf") if drf else None,
+        proportion=ssn.plugins.get("proportion") if proportion else None,
+    )
+    close_session(ssn)
+    if not enc.tasks:
+        return None, None
+    a = dict(enc.arrays)
+    a["w_least"] = np.float32(1)
+    a["w_balanced"] = np.float32(1)
+    a["w_aff"] = np.float32(1)
+    assert supported(a)
+    lax_state = solve_allocate_state(a, None, enable_drf=drf, enable_proportion=proportion)
+    pallas_state = PallasSolver(a, drf, proportion, interpret=True, fetch_f32=True).solve(None)
+    return lax_state, pallas_state
+
+
+def assert_states_equal(lax_state, pallas_state, ctx=""):
+    l, p = lax_state, pallas_state
+    assert int(l.step) == int(p.step), f"{ctx}: step"
+    np.testing.assert_array_equal(np.asarray(l.assigned_node), p.assigned_node, err_msg=f"{ctx}: node")
+    np.testing.assert_array_equal(np.asarray(l.assigned_kind), p.assigned_kind, err_msg=f"{ctx}: kind")
+    np.testing.assert_array_equal(np.asarray(l.assign_pos), p.assign_pos, err_msg=f"{ctx}: pos")
+    np.testing.assert_array_equal(np.asarray(l.ready_cnt), p.ready_cnt, err_msg=f"{ctx}: ready")
+    np.testing.assert_array_equal(np.asarray(l.ptr), p.ptr, err_msg=f"{ctx}: ptr")
+    np.testing.assert_array_equal(np.asarray(l.job_active), p.job_active, err_msg=f"{ctx}: active")
+    np.testing.assert_array_equal(np.asarray(l.q_dropped), p.q_dropped, err_msg=f"{ctx}: q_dropped")
+    np.testing.assert_allclose(np.asarray(l.idle), p.idle, err_msg=f"{ctx}: idle")
+    np.testing.assert_allclose(np.asarray(l.used), p.used, err_msg=f"{ctx}: used")
+    np.testing.assert_allclose(np.asarray(l.job_alloc), p.job_alloc, err_msg=f"{ctx}: job_alloc")
+    np.testing.assert_allclose(np.asarray(l.q_alloc), p.q_alloc, err_msg=f"{ctx}: q_alloc")
+
+
+def test_synthetic_small():
+    assert_states_equal(*solve_both(synthetic(40, 5)))
+
+
+def test_synthetic_medium():
+    assert_states_equal(*solve_both(synthetic(200, 20)))
+
+
+def test_scalar_resources_multi_tenant():
+    """GPU/TPU scalar slots exercise the has-scalar gates and the Go
+    nil-scalar-map parity bits inside the kernel."""
+    assert_states_equal(
+        *solve_both(multi_tenant_ml(n_jobs=8, n_nodes=8, n_queues=3))
+    )
+
+
+def test_no_drf_no_proportion_variant():
+    lax_state, pallas_state = solve_both(synthetic(60, 6), drf=False, proportion=False)
+    assert_states_equal(lax_state, pallas_state)
+
+
+@pytest.mark.parametrize("batch", range(3))
+def test_property_pallas_equals_xla(batch):
+    """Random snapshots (gang jobs, priorities, selectors, taints,
+    residents, multi-queue) — the fused kernel must match the XLA kernel
+    decision for decision under the default conf."""
+    for seed in range(batch * 4, (batch + 1) * 4):
+        lax_state, pallas_state = solve_both(gen_cluster(seed))
+        if lax_state is None:
+            continue
+        assert_states_equal(lax_state, pallas_state, ctx=f"seed {seed}")
+
+
+def test_action_uses_pallas_in_interpret_mode(monkeypatch):
+    """End-to-end through the action: KBT_PALLAS=interpret must produce
+    the exact lax-path session outcome (binds and task states)."""
+    from kube_batch_tpu.actions.xla_allocate import XlaAllocateAction
+
+    def run(mode):
+        monkeypatch.setenv("KBT_PALLAS", mode)
+        cache = FakeCache(synthetic(80, 8))
+        ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+        XlaAllocateAction(dtype=np.float32).execute(ssn)
+        state = {}
+        for job in ssn.jobs.values():
+            for tasks in job.task_status_index.values():
+                for t in tasks.values():
+                    state[t.uid] = (t.status, t.node_name)
+        close_session(ssn)
+        return state, dict(cache.binder.binds)
+
+    lax_state, lax_binds = run("0")
+    pallas_state, pallas_binds = run("interpret")
+    assert pallas_state == lax_state
+    assert pallas_binds == lax_binds
