@@ -16,6 +16,7 @@ job batch.
 
 from __future__ import annotations
 
+from kube_batch_tpu import log
 from kube_batch_tpu.api.job_info import TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.types import TaskStatus
@@ -97,6 +98,10 @@ class AllocateAction(Action):
 
                 candidates = predicate_nodes(task, all_nodes, predicate_fn)
                 if not candidates:
+                    log.V(3).infof(
+                        "no node fits task <%s/%s>; job <%s> leaves the cycle",
+                        task.namespace, task.name, job.name,
+                    )
                     break
 
                 node_scores = prioritize_nodes(
@@ -105,6 +110,10 @@ class AllocateAction(Action):
                 node = select_best_node(node_scores)
 
                 if task.init_resreq.less_equal(node.idle):
+                    log.V(3).infof(
+                        "binding task <%s/%s> to node <%s>",
+                        task.namespace, task.name, node.name,
+                    )
                     ssn.allocate(task, node.name)
                 else:
                     # Record the miss, try the releasing pool (allocate.go:162-180).
@@ -112,6 +121,10 @@ class AllocateAction(Action):
                     delta.fit_delta(task.init_resreq)
                     job.nodes_fit_delta[node.name] = delta
                     if task.init_resreq.less_equal(node.releasing):
+                        log.V(3).infof(
+                            "pipelining task <%s/%s> onto releasing node <%s>",
+                            task.namespace, task.name, node.name,
+                        )
                         ssn.pipeline(task, node.name)
 
                 if ssn.job_ready(job):

@@ -17,9 +17,10 @@ def register_all_actions() -> None:
     # The vectorized TPU path needs jax; without it the scheduler still
     # works serially and a conf naming xla_allocate fails at load time.
     try:
-        from kube_batch_tpu.actions import xla_allocate, xla_preempt
+        from kube_batch_tpu.actions import xla_allocate, xla_preempt, xla_reclaim
 
         register_action(xla_allocate.new())
         register_action(xla_preempt.new())
+        register_action(xla_reclaim.new())
     except ImportError:
         pass

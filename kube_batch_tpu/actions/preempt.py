@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import log, metrics
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.resource_info import Resource
@@ -82,6 +82,11 @@ def _preempt(
         preempted = Resource.empty()
         while not victims_queue.empty():
             preemptee = victims_queue.pop()
+            log.V(3).infof(
+                "evicting task <%s/%s> for preemptor <%s/%s>",
+                preemptee.namespace, preemptee.name,
+                preemptor.namespace, preemptor.name,
+            )
             stmt.evict(preemptee, "preempt")
             preempted.add(preemptee.resreq)
             if resreq.less_equal(preempted):
@@ -90,6 +95,10 @@ def _preempt(
         metrics.register_preemption_attempts()
 
         if preemptor.init_resreq.less_equal(preempted):
+            log.V(3).infof(
+                "preempted <%s> on node <%s> for task <%s/%s>",
+                preempted, node.name, preemptor.namespace, preemptor.name,
+            )
             stmt.pipeline(preemptor, node.name)
             return True
 

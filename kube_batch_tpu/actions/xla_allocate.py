@@ -1,10 +1,12 @@
-"""xla_allocate action: the allocate loop as one XLA program.
+"""xla_allocate action: the allocate loop as one device program.
 
 Drop-in replacement for the serial allocate action (conf
 ``actions: "enqueue, xla_allocate, backfill"``): encodes the session
-snapshot to SoA tensors (ops.encode), runs the jitted gang-aware solve
-(ops.kernels.solve_allocate) that vectorizes the reference's per-task
-node scans (scheduler_helper.go:34-109) over the whole node axis, then
+snapshot to SoA tensors (ops.encode), runs the gang-aware device solve —
+the fused Pallas kernel (ops.pallas_solve) on TPU, the jitted XLA
+`lax.while_loop` twin (ops.kernels.solve_allocate) elsewhere and as the
+runtime fallback — which vectorizes the reference's per-task node scans
+(scheduler_helper.go:34-109) over the whole node axis, then
 **bulk-replays** the resulting assignments into the session — the same
 state mutations `ssn.allocate`/`ssn.pipeline` would make (status index
 moves, node accounting, drf/proportion event bookkeeping, the gang
@@ -64,6 +66,7 @@ _SUPPORTED_PLUGINS = {
     "predicates",
     "proportion",
     "nodeorder",
+    "tensorscore",  # nodeorder's scores served as vectors — same policy
 }
 
 # The per-plugin enable flags the conf schema knows (conf/__init__.py);
@@ -81,26 +84,28 @@ _ENABLE_FLAGS = (
 )
 
 
-def _nodeorder_weights(ssn: Session) -> tuple[float, float, float]:
-    """(w_least, w_balanced, w_aff) from the tiers, matching the serial
-    plugin's defaults (nodeorder.go:139-153)."""
+def _nodeorder_weights(ssn: Session) -> tuple[float, float, float, float]:
+    """(w_least, w_balanced, w_aff, w_podaff) from the tiers, matching the
+    serial plugin's defaults (nodeorder.go:139-153)."""
     from kube_batch_tpu.framework.arguments import Arguments
     from kube_batch_tpu.plugins.nodeorder import (
         BALANCED_RESOURCE_WEIGHT,
         LEAST_REQUESTED_WEIGHT,
         NODE_AFFINITY_WEIGHT,
+        POD_AFFINITY_WEIGHT,
     )
 
     for tier in ssn.tiers:
         for option in tier.plugins:
-            if option.name == "nodeorder" and option.enabled_node_order:
+            if option.name in ("nodeorder", "tensorscore") and option.enabled_node_order:
                 args = Arguments(option.arguments)
                 return (
                     args.get_int(LEAST_REQUESTED_WEIGHT, 1),
                     args.get_int(BALANCED_RESOURCE_WEIGHT, 1),
                     args.get_int(NODE_AFFINITY_WEIGHT, 1),
+                    args.get_int(POD_AFFINITY_WEIGHT, 1),
                 )
-    return 0.0, 0.0, 0.0
+    return 0.0, 0.0, 0.0, 0.0
 
 
 def _kernel_supported(ssn: Session) -> bool:
@@ -189,15 +194,18 @@ class XlaAllocateAction(Action):
             return
         t_encode = _time.perf_counter() - t0
 
-        w_least, w_balanced, w_aff = _nodeorder_weights(ssn)
+        w_least, w_balanced, w_aff, w_podaff = _nodeorder_weights(ssn)
         arrays = dict(enc.arrays)
         arrays["w_least"] = dtype(w_least)
         arrays["w_balanced"] = dtype(w_balanced)
         arrays["w_aff"] = dtype(w_aff)
+        arrays["w_podaff"] = dtype(w_podaff)
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
-        solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype)
+        solve_fn = self._make_solver(
+            arrays, enable_drf, enable_proportion, dtype, enc.interpod_active
+        )
 
         t0 = _time.perf_counter()
         state = solve_fn(None)
@@ -207,6 +215,18 @@ class XlaAllocateAction(Action):
             s = jax.tree_util.tree_map(np.array, state)  # writable host copy
             replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
             s = self._host_step(ssn, enc, arrays, replay, s)
+            if enc.interpod_active:
+                # the host-stepped pod carries pod-affinity terms; once
+                # resident it shifts every group's InterPodAffinity score
+                from kube_batch_tpu.ops.encode import compute_pod_sc
+
+                arrays["pod_sc"] = compute_pod_sc(
+                    enc.task_reps,
+                    ssn.nodes,
+                    enc.node_names,
+                    arrays["pod_sc"].shape[1],
+                    dtype,
+                )
             state = solve_fn(s)
 
         result = result_of(state)
@@ -223,17 +243,26 @@ class XlaAllocateAction(Action):
             "replay_s": _time.perf_counter() - t0,
         }
 
-    def _make_solver(self, arrays, enable_drf: bool, enable_proportion: bool, dtype):
+    def _make_solver(
+        self,
+        arrays,
+        enable_drf: bool,
+        enable_proportion: bool,
+        dtype,
+        interpod_active: bool = False,
+    ):
         """Pick the device solve: the fused Pallas kernel on TPU-class
         backends (float32, in-envelope snapshots), else the XLA
         `lax.while_loop` kernel. `KBT_PALLAS=0` forces the XLA kernel;
         `KBT_PALLAS=interpret` runs the Pallas kernel in interpreter mode
-        (CPU parity tests)."""
+        (CPU parity tests). Snapshots with live InterPodAffinity scores
+        use the XLA kernel — its pod_sc input refreshes between resumes,
+        while the Pallas solver packs statics once."""
         from kube_batch_tpu.ops.kernels import solve_allocate_state
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None
-        if mode != "0" and dtype == np.float32:
+        if mode != "0" and dtype == np.float32 and not interpod_active:
             import jax as _jax
 
             from kube_batch_tpu.ops import pallas_solve

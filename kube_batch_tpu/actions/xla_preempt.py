@@ -35,153 +35,10 @@ allocate hybrid routes them host-side.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
-
-import numpy as np
-
+from kube_batch_tpu.actions.scan import ScanStatement, VectorScan
 from kube_batch_tpu.api.job_info import TaskInfo
-from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import Session
-from kube_batch_tpu.framework.statement import Statement
-
-MAX_PRIORITY = 10
-
-
-class _VectorScan:
-    """Vectorized predicate + score scan over the node axis.
-
-    Wraps the encoder's dedup'd matrices with float64 mirrors of the
-    scan-visible dynamic node state (pod count, host ports, Used cpu/mem).
-    `candidates(task)` reproduces predicate_nodes + prioritize_nodes +
-    sort_nodes for one task; returns None for host-only tasks (required
-    pod affinity) so the caller can scan serially.
-    """
-
-    def __init__(self, ssn: Session) -> None:
-        from kube_batch_tpu.actions.xla_allocate import _nodeorder_weights
-        from kube_batch_tpu.ops.encode import encode_session
-
-        enc = encode_session(ssn.jobs, ssn.nodes, ssn.queues, dtype=np.float64)
-        self.enc = enc
-        a = enc.arrays
-        N = enc.n_nodes
-        self.node_list = [ssn.nodes[name] for name in enc.node_names]
-        self.node_row = {name: i for i, name in enumerate(enc.node_names)}
-        self.task_row = {t.uid: i for i, t in enumerate(enc.tasks)}
-        self.task_gid = np.asarray(a["task_gid"])
-        self.host_only = np.asarray(a["task_host_only"])
-        self.compat = np.asarray(a["compat"])
-        self.aff_sc = np.asarray(a["aff_sc"], np.float64)
-        self.node_gid = np.asarray(a["node_gid"])[:N]
-        self.node_ok = np.asarray(a["node_ok"])[:N]
-        self.max_tasks = np.asarray(a["node_max_tasks"])[:N]
-        self.cap_cpu = np.asarray(a["node_alloc"], np.float64)[:N, 0]
-        self.cap_mem = np.asarray(a["node_alloc"], np.float64)[:N, 1]
-        # dynamic mirrors (see module docstring)
-        self.ntasks = np.asarray(a["node_ntasks"])[:N].copy()
-        P = a["task_ports"].shape[1]
-        # int64 bitmask: shifting by >= 64 silently yields 0 in numpy, so
-        # beyond 63 distinct host ports every task scans serially instead
-        self.disabled = P > 63
-        bits = 1 << np.arange(min(P, 63), dtype=np.int64)
-        ports = np.asarray(a["task_ports"])[:, : min(P, 63)]
-        self.task_ports = (ports * bits).sum(axis=1)
-        self.node_ports = (
-            np.asarray(a["node_ports"])[:N, : min(P, 63)] * bits
-        ).sum(axis=1)
-        self.used_cpu = np.asarray(a["node_used"], np.float64)[:N, 0].copy()
-        self.used_mem = np.asarray(a["node_used"], np.float64)[:N, 1].copy()
-        self.rowidx = np.arange(N)
-        self.w_least, self.w_balanced, self.w_aff = _nodeorder_weights(ssn)
-
-    def candidates(self, task: TaskInfo) -> Optional[list[NodeInfo]]:
-        if self.disabled:
-            return None
-        row = self.task_row.get(task.uid)
-        if row is None or self.host_only[row]:
-            return None
-        g = int(self.task_gid[row])
-        cand = (
-            self.compat[g, self.node_gid]
-            & self.node_ok
-            & (self.ntasks < self.max_tasks)
-            & ((self.task_ports[row] & self.node_ports) == 0)
-        )
-        if not cand.any():
-            return []
-
-        # nodeorder score, float64-identical to plugins/nodeorder.py
-        req_cpu = self.used_cpu + task.resreq.milli_cpu
-        req_mem = self.used_mem + task.resreq.memory
-
-        def least_dim(rq, cp):
-            safe = np.where(cp == 0.0, 1.0, cp)
-            sc = np.floor_divide((cp - rq) * MAX_PRIORITY, safe)
-            return np.where((cp == 0.0) | (rq > cp), 0.0, sc)
-
-        least = np.floor_divide(
-            least_dim(req_cpu, self.cap_cpu) + least_dim(req_mem, self.cap_mem), 2.0
-        )
-        cpu_f = np.where(
-            self.cap_cpu != 0.0, req_cpu / np.where(self.cap_cpu == 0.0, 1.0, self.cap_cpu), 1.0
-        )
-        mem_f = np.where(
-            self.cap_mem != 0.0, req_mem / np.where(self.cap_mem == 0.0, 1.0, self.cap_mem), 1.0
-        )
-        balanced = np.where(
-            (cpu_f >= 1.0) | (mem_f >= 1.0),
-            0.0,
-            np.trunc(MAX_PRIORITY - np.abs(cpu_f - mem_f) * MAX_PRIORITY),
-        )
-        score = (
-            least * self.w_least
-            + balanced * self.w_balanced
-            + self.aff_sc[g, self.node_gid] * self.w_aff
-        )
-        # sort_nodes order: score desc, ties by node row (= name order)
-        order = np.lexsort((self.rowidx, -score))
-        order = order[cand[order]]
-        return [self.node_list[r] for r in order]
-
-    # -- Statement-visible mutations --------------------------------------
-
-    def on_pipeline(self, task: TaskInfo, hostname: str) -> None:
-        n = self.node_row[hostname]
-        self.ntasks[n] += 1
-        self.used_cpu[n] += task.resreq.milli_cpu
-        self.used_mem[n] += task.resreq.memory
-        row = self.task_row.get(task.uid)
-        if row is not None:
-            self.node_ports[n] |= self.task_ports[row]
-
-    def on_unpipeline(self, task: TaskInfo, hostname: str) -> None:
-        n = self.node_row[hostname]
-        self.ntasks[n] -= 1
-        self.used_cpu[n] -= task.resreq.milli_cpu
-        self.used_mem[n] -= task.resreq.memory
-        row = self.task_row.get(task.uid)
-        if row is not None:
-            # exclusive holder: two tasks with the same host port can never
-            # co-reside (the predicate forbids it), so clearing is exact
-            self.node_ports[n] &= ~self.task_ports[row]
-
-
-class _ScanStatement(Statement):
-    """Statement that keeps the vector scan's node mirrors in sync."""
-
-    def __init__(self, ssn: Session, scan: _VectorScan) -> None:
-        super().__init__(ssn)
-        self._scan = scan
-
-    def pipeline(self, task: TaskInfo, hostname: str) -> None:
-        super().pipeline(task, hostname)
-        self._scan.on_pipeline(task, hostname)
-
-    def _unpipeline(self, task: TaskInfo) -> None:
-        hostname = task.node_name
-        super()._unpipeline(task)
-        self._scan.on_unpipeline(task, hostname)
 
 
 class XlaPreemptAction(Action):
@@ -197,7 +54,7 @@ class XlaPreemptAction(Action):
     def execute(self, ssn: Session) -> None:
         from kube_batch_tpu.actions.preempt import run_preempt, serial_candidates
 
-        scan = _VectorScan(ssn)
+        scan = VectorScan(ssn)
 
         def candidates(s: Session, preemptor: TaskInfo):
             selected = scan.candidates(preemptor)
@@ -209,7 +66,7 @@ class XlaPreemptAction(Action):
 
         run_preempt(
             ssn,
-            statement_factory=lambda s: _ScanStatement(s, scan),
+            statement_factory=lambda s: ScanStatement(s, scan),
             candidates_fn=candidates,
         )
 

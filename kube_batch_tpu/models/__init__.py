@@ -189,6 +189,58 @@ def multi_tenant_ml(
     return build_cluster(pods, nodes, pgs, queues)
 
 
+def preempt_contended(
+    n_nodes: int = 200, victim_tasks: int = 4, n_preemptor_jobs: int = 150,
+    tasks_per_job: int = 4, seed: int = 0
+) -> ClusterInfo:
+    """A preemption-heavy scene for benching the preempt actions: every
+    node slot held by low-priority gang members, higher-priority gangs
+    starved behind them (the preempt.go:81-170 working set)."""
+    rng = random.Random(seed)
+    nodes = [
+        build_node(f"node-{i:05d}", build_resource_list(cpu=2, memory="4096Mi", pods=10))
+        for i in range(n_nodes)
+    ]
+    pods, pgs = [], []
+    slots = [(i, s) for i in range(n_nodes) for s in range(2)]
+    si = 0
+    j = 0
+    while si < len(slots):
+        name = f"low-{j:04d}"
+        pgs.append(build_pod_group(name, min_member=0))
+        for t in range(victim_tasks):
+            if si >= len(slots):
+                break
+            node_i, _ = slots[si]
+            si += 1
+            pods.append(
+                build_pod(
+                    name=f"{name}-t{t}",
+                    group_name=name,
+                    node_name=f"node-{node_i:05d}",
+                    phase=PodPhase.RUNNING,
+                    req=build_resource_list(cpu=1, memory="2048Mi"),
+                    priority=1,
+                )
+            )
+        j += 1
+    for j in range(n_preemptor_jobs):
+        name = f"high-{j:04d}"
+        pgs.append(build_pod_group(name, min_member=max(tasks_per_job // 2, 1)))
+        for t in range(tasks_per_job):
+            pods.append(
+                build_pod(
+                    name=f"{name}-t{t}",
+                    group_name=name,
+                    req=build_resource_list(
+                        cpu=1, memory=f"{rng.choice([1024, 2048])}Mi"
+                    ),
+                    priority=9,
+                )
+            )
+    return build_cluster(pods, nodes, pgs, [build_queue("default")])
+
+
 CONFIGS = {
     "gang_example": gang_example,
     "synthetic_1k_100": lambda: synthetic(1000, 100),

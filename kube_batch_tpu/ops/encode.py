@@ -78,17 +78,28 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return 1 << (size - 1).bit_length()
 
 
-_PLAIN_SIG = ((), "None", ())
+_PLAIN_SIG = ((), "None", (), ())
 
 
-def _task_signature(task: TaskInfo) -> tuple:
+def _task_signature(task: TaskInfo, with_labels: bool = False) -> tuple:
+    """Dedup key for the (task-group x node-group) predicate matrices.
+    ``with_labels`` extends the key with the pod's own labels — needed
+    when any pod in the snapshot carries pod-affinity terms, because the
+    symmetric InterPodAffinity score reads the *incoming* pod's labels
+    (plugins/nodeorder.py interpod_affinity_scores)."""
     pod = task.pod
-    if not pod.node_selector and pod.affinity is None and not pod.tolerations:
+    if (
+        not pod.node_selector
+        and pod.affinity is None
+        and not pod.tolerations
+        and not (with_labels and pod.metadata.labels)
+    ):
         return _PLAIN_SIG  # fast path: the overwhelmingly common pod shape
     return (
         tuple(sorted(pod.node_selector.items())),
         repr(pod.affinity),
         tuple(sorted(repr(t) for t in pod.tolerations)),
+        tuple(sorted(pod.metadata.labels.items())) if with_labels else (),
     )
 
 
@@ -146,10 +157,35 @@ class EncodedSnapshot:
     n_queues: int
     host_only: list[TaskInfo] = field(default_factory=list)
     arrays: dict = field(default_factory=dict)
+    # pod-affinity terms present somewhere in the snapshot: interpod
+    # scores are live (arrays["pod_sc"] nonzero-able, refreshed by the
+    # action after each host-stepped placement)
+    interpod_active: bool = False
+    task_reps: list[TaskInfo] = field(default_factory=list)  # group reps
 
     @property
     def has_host_only(self) -> bool:
         return bool(self.host_only)
+
+
+def compute_pod_sc(
+    task_reps: Sequence[TaskInfo],
+    nodes: dict[str, NodeInfo],
+    node_names: Sequence[str],
+    n_pad: int,
+    dtype,
+) -> np.ndarray:
+    """[GT, N] InterPodAffinity score matrix — one normalized 0..10 row
+    per task group against the *current* residents. Exact for every task
+    whose group rep shares its labels + affinity spec (the group
+    signature guarantees that when interpod is active)."""
+    from kube_batch_tpu.plugins.nodeorder import interpod_affinity_scores
+
+    out = np.zeros((max(len(task_reps), 1), n_pad), dtype)
+    for gi, rep in enumerate(task_reps):
+        scores = interpod_affinity_scores(rep, nodes)
+        out[gi, : len(node_names)] = [scores[name] for name in node_names]
+    return out
 
 
 def _collect_scalar_names(
@@ -238,11 +274,25 @@ def encode_session(
         start = len(task_list)
         for t in pending:
             aff = t.pod.affinity
-            if aff is not None and (aff.pod_affinity_required or aff.pod_anti_affinity_required):
+            if aff is not None and aff.has_pod_affinity_terms():
+                # required terms gate feasibility pairwise; preferred terms
+                # change *other* tasks' scores once this pod lands (the
+                # symmetric InterPodAffinity half) — both must be stepped
+                # host-side against the live session
                 host_only.append(t)
                 host_only_rows.append(len(task_list))
             task_list.append(t)
         job_ranges.append((start, len(task_list)))
+
+    # InterPodAffinity activation: any pod-affinity terms anywhere (pending
+    # or resident) make nodeorder's interpod score nonzero-able; the score
+    # is per *node* (it reads each node's residents), so it rides its own
+    # [GT, N] matrix rather than the node-group-level aff_sc.
+    interpod_active = bool(host_only) or any(
+        rt.pod.affinity is not None and rt.pod.affinity.has_pod_affinity_terms()
+        for n in node_list
+        for rt in n.tasks.values()
+    )
 
     scalar_names = _collect_scalar_names(task_list, node_list)
     R = 2 + len(scalar_names)
@@ -263,7 +313,7 @@ def encode_session(
     task_gid = np.zeros(T, np.int32)
     t_reps: list[TaskInfo] = []
     for i, t in enumerate(task_list):
-        sig = _task_signature(t)
+        sig = _task_signature(t, with_labels=interpod_active)
         if sig not in t_groups:
             t_groups[sig] = len(t_reps)
             t_reps.append(t)
@@ -410,6 +460,11 @@ def encode_session(
 
     eps = np.asarray(Resource.vector_epsilons(scalar_names), dtype)
 
+    if interpod_active:
+        pod_sc = compute_pod_sc(t_reps, nodes, [n.name for n in node_list], N, dtype)
+    else:
+        pod_sc = np.zeros((GT, N), dtype)
+
     return EncodedSnapshot(
         scalar_names=scalar_names,
         tasks=task_list,
@@ -421,6 +476,8 @@ def encode_session(
         n_jobs=j_n,
         n_queues=q_n,
         host_only=host_only,
+        interpod_active=interpod_active,
+        task_reps=t_reps,
         arrays=dict(
             task_req=task_req,
             task_res=task_res,
@@ -444,6 +501,7 @@ def encode_session(
             node_ports=node_ports,
             compat=compat,
             aff_sc=aff_sc,
+            pod_sc=pod_sc,
             job_start=job_start,
             job_end=job_end,
             job_min=job_min,

@@ -193,6 +193,7 @@ def solve_allocate_step(
     node_gid = a["node_gid"]
     compat = a["compat"]
     aff_sc = a["aff_sc"]
+    pod_sc = a["pod_sc"]  # [GT, N] InterPodAffinity (zeros when inactive)
     job_end = a["job_end"]
     job_min = a["job_min"]
     job_prio = a["job_prio"]
@@ -204,6 +205,7 @@ def solve_allocate_step(
     w_least = jnp.asarray(a["w_least"], fdtype)
     w_balanced = jnp.asarray(a["w_balanced"], fdtype)
     w_aff = jnp.asarray(a["w_aff"], fdtype)
+    w_podaff = jnp.asarray(a["w_podaff"], fdtype)
     if enable_drf:
         drf_total = a["drf_total"]
         drf_dims = a["drf_dims"]
@@ -326,6 +328,7 @@ def solve_allocate_step(
             least.astype(fdtype) * w_least
             + balanced.astype(fdtype) * w_balanced
             + aff_sc[task_gid[t], node_gid] * w_aff
+            + pod_sc[task_gid[t]] * w_podaff
         )
         nb = jnp.argmax(jnp.where(cand, score, -jnp.inf)).astype(jnp.int32)
 

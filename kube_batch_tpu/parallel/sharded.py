@@ -57,11 +57,18 @@ def make_mesh(
 
 
 def node_shardings(arrays: dict, mesh: Mesh, axis_name: str = AXIS_NAME) -> dict:
-    """PartitionSpec per array: node-axis arrays sharded, rest replicated."""
-    return {
-        k: NamedSharding(mesh, P(axis_name) if k in NODE_AXIS_ARRAYS else P())
-        for k in arrays
-    }
+    """PartitionSpec per array: node-axis arrays sharded, rest replicated.
+    pod_sc is [task-groups, nodes] — node axis second."""
+    out = {}
+    for k in arrays:
+        if k in NODE_AXIS_ARRAYS:
+            spec = P(axis_name)
+        elif k == "pod_sc":
+            spec = P(None, axis_name)
+        else:
+            spec = P()
+        out[k] = NamedSharding(mesh, spec)
+    return out
 
 
 def sharded_solve_allocate(

@@ -1,0 +1,278 @@
+"""InterPodAffinity priority: full k8s-1.13 symmetric-weight parity
+(reference nodeorder.go:210-216 -> CalculateInterPodAffinityPriority) and
+serial ≡ xla equivalence when interpod scores are live.
+"""
+
+from kube_batch_tpu import actions  # noqa: F401
+from kube_batch_tpu import plugins  # noqa: F401
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.apis.types import Affinity, PodAffinityTerm, PodPhase
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.plugins.nodeorder import interpod_affinity_scores
+from kube_batch_tpu.testing import (
+    FakeCache,
+    build_cluster,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+TIERS_YAML = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def _node_info(name, labels=None, residents=()):
+    node = build_node(name, build_resource_list(cpu=16, memory="32Gi", pods=20), labels=labels)
+    ni = NodeInfo(node)
+    for pod in residents:
+        ni.add_task(TaskInfo(pod))
+    return ni
+
+
+def _running(name, labels=None, affinity=None, node_name="n0"):
+    pod = build_pod(
+        name=name,
+        node_name=node_name,
+        phase=PodPhase.RUNNING,
+        req=build_resource_list(cpu=1, memory="1Gi"),
+        labels=labels,
+    )
+    pod.affinity = affinity
+    return pod
+
+
+def test_incoming_preferred_affinity_scores_domain():
+    """Incoming pod prefers co-location with app=web by zone: the zone
+    hosting a web pod outranks the other; normalization is 0..10."""
+    web = _running("web", labels={"app": "web"}, node_name="n0")
+    nodes = {
+        "n0": _node_info("n0", {"zone": "a"}, [web]),
+        "n1": _node_info("n1", {"zone": "a"}),
+        "n2": _node_info("n2", {"zone": "b"}),
+    }
+    task = TaskInfo(build_pod(name="in", req=build_resource_list(cpu=1, memory="1Gi")))
+    task.pod.affinity = Affinity(
+        pod_affinity_preferred=[(3, PodAffinityTerm({"app": "web"}, "zone"))]
+    )
+    scores = interpod_affinity_scores(task, nodes)
+    # zone a (n0, n1) gets weight 3, zone b gets 0 -> normalized 10 vs 0
+    assert scores == {"n0": 10, "n1": 10, "n2": 0}
+
+
+def test_incoming_preferred_anti_affinity_penalizes_domain():
+    web = _running("web", labels={"app": "web"}, node_name="n0")
+    nodes = {
+        "n0": _node_info("n0", {"zone": "a"}, [web]),
+        "n1": _node_info("n1", {"zone": "b"}),
+    }
+    task = TaskInfo(build_pod(name="in", req=build_resource_list(cpu=1, memory="1Gi")))
+    task.pod.affinity = Affinity(
+        pod_anti_affinity_preferred=[(5, PodAffinityTerm({"app": "web"}, "zone"))]
+    )
+    scores = interpod_affinity_scores(task, nodes)
+    assert scores == {"n0": 0, "n1": 10}  # -5 vs 0, min-max normalized
+
+
+def test_symmetric_preferred_from_resident():
+    """A resident pod PREFERS pods like the incoming one: the resident's
+    term scores the incoming pod toward the resident's domain even though
+    the incoming pod itself has no affinity at all."""
+    lover = _running(
+        "lover",
+        labels={},
+        affinity=Affinity(
+            pod_affinity_preferred=[(7, PodAffinityTerm({"role": "friend"}, "kubernetes.io/hostname"))]
+        ),
+        node_name="n0",
+    )
+    nodes = {
+        "n0": _node_info("n0", residents=[lover]),
+        "n1": _node_info("n1"),
+    }
+    task = TaskInfo(
+        build_pod(name="in", req=build_resource_list(cpu=1, memory="1Gi"), labels={"role": "friend"})
+    )
+    scores = interpod_affinity_scores(task, nodes)
+    assert scores == {"n0": 10, "n1": 0}
+    # a pod NOT matching the resident's selector gets nothing
+    other = TaskInfo(build_pod(name="other", req=build_resource_list(cpu=1, memory="1Gi")))
+    assert interpod_affinity_scores(other, nodes) == {"n0": 0, "n1": 0}
+
+
+def test_hard_symmetric_weight_from_required_terms():
+    """A resident's REQUIRED affinity terms toward the incoming pod score
+    the hard symmetric weight (v1.DefaultHardPodAffinitySymmetricWeight)."""
+    needy = _running(
+        "needy",
+        affinity=Affinity(
+            pod_affinity_required=[PodAffinityTerm({"app": "db"}, "kubernetes.io/hostname")]
+        ),
+        node_name="n1",
+    )
+    nodes = {
+        "n0": _node_info("n0"),
+        "n1": _node_info("n1", residents=[needy]),
+    }
+    task = TaskInfo(
+        build_pod(name="in", req=build_resource_list(cpu=1, memory="1Gi"), labels={"app": "db"})
+    )
+    assert interpod_affinity_scores(task, nodes) == {"n0": 0, "n1": 10}
+
+
+def test_no_terms_anywhere_all_zero():
+    nodes = {"n0": _node_info("n0", residents=[_running("r")]), "n1": _node_info("n1")}
+    task = TaskInfo(build_pod(name="in", req=build_resource_list(cpu=1, memory="1Gi")))
+    assert interpod_affinity_scores(task, nodes) == {"n0": 0, "n1": 0}
+
+
+# -- serial ≡ xla with live interpod scores ----------------------------------
+
+
+def run_and_capture(action_name, cluster):
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(TIERS_YAML).tiers)
+    get_action(action_name).execute(ssn)
+    state = {}
+    for job in ssn.jobs.values():
+        for tasks in job.task_status_index.values():
+            for t in tasks.values():
+                state[t.uid] = (t.status, t.node_name)
+    close_session(ssn)
+    return state, dict(cache.binder.binds)
+
+
+def assert_equivalent(make_cluster):
+    s_state, s_binds = run_and_capture("allocate", make_cluster())
+    x_state, x_binds = run_and_capture("xla_allocate", make_cluster())
+    assert x_binds == s_binds
+    assert x_state == s_state
+
+
+def test_serial_equals_xla_resident_terms_shift_plain_tasks():
+    """Residents with preferred terms give NON-affinity pending tasks
+    nonzero interpod scores; the kernel's pod_sc matrix must reproduce
+    the serial plugin's placements."""
+
+    def mk():
+        magnet = build_pod(
+            name="magnet",
+            node_name="n2",
+            phase=PodPhase.RUNNING,
+            req=build_resource_list(cpu=1, memory="1Gi"),
+        )
+        magnet.affinity = Affinity(
+            pod_affinity_preferred=[(9, PodAffinityTerm({"tier": "app"}, "kubernetes.io/hostname"))]
+        )
+        pods = [magnet] + [
+            build_pod(
+                name=f"p{i}",
+                group_name="pg",
+                req=build_resource_list(cpu=1, memory="1Gi"),
+                labels={"tier": "app"},
+            )
+            for i in range(3)
+        ]
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=4, memory="8Gi", pods=10))
+            for i in range(4)
+        ]
+        return build_cluster(
+            pods, nodes, [build_pod_group("pg", min_member=1)], [build_queue("default")]
+        )
+
+    # sanity: the serial path actually pulls tasks toward the magnet node
+    _, binds = run_and_capture("allocate", mk())
+    assert "n2" in binds.values()
+    assert_equivalent(mk)
+
+
+def test_serial_equals_xla_pending_preferred_terms_refresh():
+    """Pending tasks carrying preferred terms step host-side and refresh
+    pod_sc between kernel resumes: once the first lands, the second's
+    preference for it must act — identically in both paths."""
+
+    def mk():
+        pods = []
+        for i in range(2):
+            pod = build_pod(
+                name=f"pair{i}",
+                group_name=f"pg{i}",
+                req=build_resource_list(cpu=1, memory="1Gi"),
+                labels={"pack": "yes"},
+            )
+            pod.affinity = Affinity(
+                pod_affinity_preferred=[(8, PodAffinityTerm({"pack": "yes"}, "kubernetes.io/hostname"))]
+            )
+            pods.append(pod)
+        pods.append(
+            build_pod(name="plain", group_name="pg2", req=build_resource_list(cpu=1, memory="1Gi"))
+        )
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=4, memory="8Gi", pods=10))
+            for i in range(3)
+        ]
+        pgs = [build_pod_group(f"pg{i}", min_member=1) for i in range(3)]
+        return build_cluster(pods, nodes, pgs, [build_queue("default")])
+
+    assert_equivalent(mk)
+
+
+def test_preempt_parity_with_interpod_active():
+    """xla_preempt disables the vector scan when interpod is live and
+    must still match the serial action exactly."""
+    from test_xla_preempt import PREEMPT_TIERS
+
+    def mk():
+        victims = [
+            build_pod(
+                name=f"low{i}",
+                group_name="low",
+                req=build_resource_list(cpu=1, memory="512Mi"),
+                node_name=f"n{i}",
+                phase=PodPhase.RUNNING,
+                priority=1,
+                labels={"kind": "victim"},
+            )
+            for i in range(2)
+        ]
+        hi = build_pod(
+            name="hi", group_name="hi", req=build_resource_list(cpu=1, memory="512Mi"), priority=9
+        )
+        hi.affinity = Affinity(
+            pod_affinity_preferred=[(2, PodAffinityTerm({"kind": "victim"}, "kubernetes.io/hostname"))]
+        )
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=1, memory="1Gi", pods=5))
+            for i in range(2)
+        ]
+        return build_cluster(
+            victims + [hi],
+            nodes,
+            [build_pod_group("low", min_member=1), build_pod_group("hi", min_member=1)],
+            [build_queue("default")],
+        )
+
+    def runp(action):
+        cache = FakeCache(mk())
+        ssn = open_session(cache, parse_scheduler_conf(PREEMPT_TIERS).tiers)
+        get_action(action).execute(ssn)
+        ev = list(cache.evictor.evicts)
+        close_session(ssn)
+        return ev
+
+    assert runp("preempt") == runp("xla_preempt")

@@ -45,6 +45,7 @@ def solve_both(cluster, drf=True, proportion=True):
     a["w_least"] = np.float32(1)
     a["w_balanced"] = np.float32(1)
     a["w_aff"] = np.float32(1)
+    a["w_podaff"] = np.float32(1)
     assert supported(a)
     lax_state = solve_allocate_state(a, None, enable_drf=drf, enable_proportion=proportion)
     pallas_state = PallasSolver(a, drf, proportion, interpret=True, fetch_f32=True).solve(None)

@@ -215,3 +215,52 @@ def test_leader_election_mutual_exclusion(tmp_path):
     a.release()
     assert b.acquire(blocking=False)  # failover after the leader lets go
     b.release()
+
+
+class TestCLI:
+    """kbt-ctl against a live server (pkg/cli/queue parity)."""
+
+    def test_queue_create_list_delete(self):
+        import io
+
+        from kube_batch_tpu.cli import main
+        from kube_batch_tpu.server import SchedulerServer
+
+        server = SchedulerServer(listen_address="127.0.0.1:0")
+        server.start()
+        try:
+            addr = f"http://127.0.0.1:{server.listen_port}"
+            assert main(["--server", addr, "queue", "create", "--name", "gold", "--weight", "5"]) == 0
+            out = io.StringIO()
+            assert main(["--server", addr, "queue", "list"], out=out) == 0
+            lines = out.getvalue().splitlines()
+            assert lines[0].startswith("Name")
+            assert any(l.startswith("gold") and "5" in l for l in lines[1:])
+            assert main(["--server", addr, "queue", "delete", "--name", "gold"]) == 0
+            out = io.StringIO()
+            assert main(["--server", addr, "queue", "list"], out=out) == 0
+            assert not any(l.startswith("gold") for l in out.getvalue().splitlines())
+        finally:
+            server.stop()
+
+    def test_version_command(self):
+        import io
+
+        from kube_batch_tpu.cli import main
+
+        out = io.StringIO()
+        assert main(["version"], out=out) == 0
+        assert "API Version" in out.getvalue()
+
+    def test_create_duplicate_errors(self):
+        from kube_batch_tpu.cli import main
+        from kube_batch_tpu.server import SchedulerServer
+
+        server = SchedulerServer(listen_address="127.0.0.1:0")
+        server.start()
+        try:
+            addr = f"http://127.0.0.1:{server.listen_port}"
+            assert main(["--server", addr, "queue", "create", "--name", "dup"]) == 0
+            assert main(["--server", addr, "queue", "create", "--name", "dup"]) == 1
+        finally:
+            server.stop()

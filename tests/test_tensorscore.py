@@ -1,0 +1,85 @@
+"""tensorscore ≡ nodeorder: the vectorized scoring plugin must place
+pods identically to the serial scoring plugin under every action path
+(SURVEY.md section 2.7d — vectorized scoring toggleable via conf)."""
+
+from kube_batch_tpu import actions  # noqa: F401
+from kube_batch_tpu import plugins  # noqa: F401
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.models import multi_tenant_ml, synthetic
+from kube_batch_tpu.testing import FakeCache
+
+from test_xla_allocate import gen_cluster
+
+
+def tiers_with(score_plugin: str, action: str = "allocate"):
+    return parse_scheduler_conf(
+        f"""
+actions: "{action}"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: {score_plugin}
+"""
+    ).tiers
+
+
+def run(action, cluster, score_plugin):
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, tiers_with(score_plugin, action))
+    get_action(action).execute(ssn)
+    state = {
+        t.uid: (t.status, t.node_name)
+        for j in ssn.jobs.values()
+        for d in j.task_status_index.values()
+        for t in d.values()
+    }
+    close_session(ssn)
+    return state, dict(cache.binder.binds), list(cache.evictor.evicts)
+
+
+def assert_same_outcome(make_cluster, action="allocate"):
+    n_state, n_binds, n_ev = run(action, make_cluster(), "nodeorder")
+    t_state, t_binds, t_ev = run(action, make_cluster(), "tensorscore")
+    assert t_binds == n_binds
+    assert t_state == n_state
+    assert t_ev == n_ev
+
+
+def test_allocate_synthetic():
+    assert_same_outcome(lambda: synthetic(300, 30))
+
+
+def test_allocate_scalar_resources():
+    assert_same_outcome(lambda: multi_tenant_ml(n_jobs=10, n_nodes=10, n_queues=4))
+
+
+def test_property_sweep():
+    for seed in range(16):
+        n = run("allocate", gen_cluster(seed), "nodeorder")
+        t = run("allocate", gen_cluster(seed), "tensorscore")
+        assert t == n, f"seed {seed} diverged"
+
+
+def test_preempt_with_tensorscore():
+    from test_xla_preempt import gen_contended_cluster
+
+    for seed in range(8):
+        n = run("preempt", gen_contended_cluster(seed), "nodeorder")
+        t = run("preempt", gen_contended_cluster(seed), "tensorscore")
+        assert t == n, f"seed {seed} diverged"
+
+
+def test_xla_allocate_accepts_tensorscore_conf():
+    """The kernel envelope treats tensorscore as nodeorder (same scores):
+    xla_allocate under a tensorscore conf == serial allocate under it."""
+    s = run("allocate", synthetic(200, 20), "tensorscore")
+    x = run("xla_allocate", synthetic(200, 20), "tensorscore")
+    assert x == s
+    assert len(s[1]) == 200
