@@ -123,3 +123,26 @@ def test_action_uses_pallas_in_interpret_mode(monkeypatch):
     pallas_state, pallas_binds = run("interpret")
     assert pallas_state == lax_state
     assert pallas_binds == lax_binds
+
+
+def test_fold_boundary_exact_128_tasks():
+    """T exactly at the fold boundary (128 tasks -> one full row)."""
+    assert_states_equal(*solve_both(synthetic(128, 4, tasks_per_job=8)))
+
+
+def test_fold_boundary_129_tasks():
+    """T one past the fold boundary (129 -> two rows, second nearly empty).
+    synthetic() builds n_pods//tasks_per_job jobs; 129 with 3-task jobs
+    gives 43 jobs x 3 = 129 tasks exactly."""
+    assert_states_equal(*solve_both(synthetic(129, 5, tasks_per_job=3)))
+
+
+def test_single_node_single_job():
+    assert_states_equal(*solve_both(synthetic(6, 1, tasks_per_job=6)))
+
+
+def test_more_tasks_than_capacity():
+    """Oversubscribed: most tasks must stay pending, gang barrier holds."""
+    lax_state, pallas_state = solve_both(synthetic(300, 2, tasks_per_job=10))
+    assert_states_equal(lax_state, pallas_state)
+    assert int(pallas_state.step) < 300
