@@ -11,12 +11,21 @@ exposes:
   equivalent; serves metrics.render_prometheus_text);
 - ``GET /healthz``   — liveness;
 - ``GET /version``   — version.info();
-- ``GET /apis/v1alpha1/queues``            — list queues (CLI backend);
-- ``POST /apis/v1alpha1/queues``           — create a queue;
-- ``DELETE /apis/v1alpha1/queues/<name>``  — delete a queue.
+- ``GET|POST /apis/v1alpha1/queues`` and
+  ``DELETE /apis/v1alpha1/queues/<name>`` — the queue CRD surface the
+  reference CLI talks to (pkg/cli/queue);
+- ``GET|POST /apis/v1alpha1/pods`` / ``nodes`` / ``podgroups`` and
+  ``DELETE /apis/v1alpha1/pods/<ns>/<name>`` (`nodes/<name>`,
+  ``podgroups/<ns>/<name>``) — the workload-ingestion surface an external
+  control plane uses to feed the in-process cluster (the list/watch half
+  the reference gets from the Kubernetes API server; here creations fan
+  out to the cache's event handlers through the store).
 
-The queue endpoints are the in-process replacement for the API-server
-CRD surface the reference CLI talks to (pkg/cli/queue).
+Pod JSON: ``{"name", "namespace", "group", "requests": {"cpu": 1,
+"memory": "512Mi", ...scalars}, "priority", "labels", "node_selector",
+"node_name", "phase", "scheduler_name"}``. Node JSON: ``{"name",
+"allocatable": {...}, "labels"}``. PodGroup JSON: ``{"name",
+"namespace", "queue", "min_member"}``.
 
 HA: the reference elects a leader through a ConfigMap resource lock
 (server.go:96-137). The in-process equivalent is an OS file lock
@@ -78,6 +87,10 @@ class LeaderElector:
             self._fh = None
 
 
+class _AlreadyExists(Exception):
+    """Create of an object whose key is already in the store (HTTP 409)."""
+
+
 def _make_handler(server: "SchedulerServer"):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route http.server chatter to V(4)
@@ -106,44 +119,173 @@ def _make_handler(server: "SchedulerServer"):
                     for q in server.store.list("queues")
                 ]
                 self._reply(200, json.dumps({"items": queues}))
+            elif self.path == "/apis/v1alpha1/pods":
+                pods = [
+                    {
+                        "namespace": p.namespace,
+                        "name": p.name,
+                        "phase": p.phase.value,
+                        "node": p.node_name,
+                    }
+                    for p in server.store.list("pods")
+                ]
+                self._reply(200, json.dumps({"items": pods}))
+            elif self.path == "/apis/v1alpha1/nodes":
+                nodes = [
+                    {"name": n.name, "allocatable": dict(n.allocatable)}
+                    for n in server.store.list("nodes")
+                ]
+                self._reply(200, json.dumps({"items": nodes}))
+            elif self.path == "/apis/v1alpha1/podgroups":
+                pgs = [
+                    {
+                        "namespace": g.metadata.namespace,
+                        "name": g.name,
+                        "queue": g.spec.queue,
+                        "min_member": g.spec.min_member,
+                        "phase": g.status.phase.value,
+                    }
+                    for g in server.store.list("podgroups")
+                ]
+                self._reply(200, json.dumps({"items": pgs}))
             else:
                 self._reply(404, json.dumps({"error": "not found"}))
 
-        def do_POST(self):  # noqa: N802
-            if self.path != "/apis/v1alpha1/queues":
-                self._reply(404, json.dumps({"error": "not found"}))
-                return
+        def _read_body(self) -> dict:
             length = int(self.headers.get("Content-Length", "0"))
-            try:
-                body = json.loads(self.rfile.read(length) or b"{}")
-                name = body["name"]
-                weight = int(body.get("weight", 1))
-                if weight < 1:
-                    raise ValueError("weight must be >= 1")
-            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
-                self._reply(400, json.dumps({"error": str(e)}))
-                return
-            try:
-                server.store.create_queue(
-                    Queue(metadata=ObjectMeta(name=name), spec=QueueSpec(weight=weight))
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_POST(self):  # noqa: N802
+            from kube_batch_tpu.apis.types import PodPhase
+            from kube_batch_tpu.testing import (
+                build_node,
+                build_pod,
+                build_pod_group,
+                build_resource_list,
+            )
+
+            # Validation before anything reaches the store: a type-poisoned
+            # object (str priority, str labels) would not fail here — it
+            # would fail inside every subsequent scheduling cycle.
+            def field(body, key, typ, default, required: bool = False):
+                if key not in body:
+                    if required:
+                        raise ValueError(f"missing required field {key!r}")
+                    return default
+                val = body[key]
+                if typ is int and isinstance(val, (int, str)):
+                    return int(val)
+                if not isinstance(val, typ):
+                    raise ValueError(
+                        f"field {key!r} must be {typ.__name__}, got {type(val).__name__}"
+                    )
+                return val
+
+            def resource_list(d) -> dict:
+                if not isinstance(d, dict):
+                    raise ValueError("resource list must be an object")
+                # k8s-style quantity strings ("8Gi", "500m") -> floats
+                return build_resource_list(
+                    cpu=d.get("cpu", 0),
+                    memory=d.get("memory", 0),
+                    pods=int(d.get("pods", 0)),
+                    **{k: v for k, v in d.items() if k not in ("cpu", "memory", "pods")},
                 )
-            except KeyError as e:
+
+            def ensure_new(kind: str, key: str) -> None:
+                if server.store.get(kind, key) is not None:
+                    raise _AlreadyExists(f"{kind} {key!r} already exists")
+
+            try:
+                body = self._read_body()
+                if not isinstance(body, dict):
+                    raise ValueError("request body must be a JSON object")
+                if self.path == "/apis/v1alpha1/queues":
+                    name = field(body, "name", str, None, required=True)
+                    weight = field(body, "weight", int, 1)
+                    if weight < 1:
+                        raise ValueError("weight must be >= 1")
+                    ensure_new("queues", name)
+                    server.store.create_queue(
+                        Queue(metadata=ObjectMeta(name=name), spec=QueueSpec(weight=weight))
+                    )
+                    self._reply(201, json.dumps({"name": name, "weight": weight}))
+                elif self.path == "/apis/v1alpha1/pods":
+                    name = field(body, "name", str, None, required=True)
+                    namespace = field(body, "namespace", str, "default")
+                    pod = build_pod(
+                        namespace=namespace,
+                        name=name,
+                        node_name=field(body, "node_name", str, ""),
+                        phase=PodPhase(field(body, "phase", str, "Pending")),
+                        req=resource_list(body.get("requests", {})),
+                        group_name=field(body, "group", str, ""),
+                        labels=field(body, "labels", dict, None),
+                        priority=field(body, "priority", int, None),
+                        node_selector=field(body, "node_selector", dict, None),
+                        scheduler_name=field(
+                            body, "scheduler_name", str, server.cache.scheduler_name
+                        ),
+                    )
+                    ensure_new("pods", f"{namespace}/{name}")
+                    server.store.create_pod(pod)
+                    self._reply(
+                        201, json.dumps({"namespace": pod.namespace, "name": pod.name})
+                    )
+                elif self.path == "/apis/v1alpha1/nodes":
+                    name = field(body, "name", str, None, required=True)
+                    node = build_node(
+                        name,
+                        resource_list(body.get("allocatable", {})),
+                        labels=field(body, "labels", dict, None),
+                    )
+                    ensure_new("nodes", name)
+                    server.store.create_node(node)
+                    self._reply(201, json.dumps({"name": node.name}))
+                elif self.path == "/apis/v1alpha1/podgroups":
+                    name = field(body, "name", str, None, required=True)
+                    namespace = field(body, "namespace", str, "default")
+                    pg = build_pod_group(
+                        name,
+                        namespace=namespace,
+                        queue=field(body, "queue", str, server.cache.default_queue),
+                        min_member=field(body, "min_member", int, 1),
+                    )
+                    ensure_new("podgroups", f"{namespace}/{name}")
+                    server.store.create_pod_group(pg)
+                    self._reply(
+                        201,
+                        json.dumps({"namespace": pg.metadata.namespace, "name": pg.name}),
+                    )
+                else:
+                    self._reply(404, json.dumps({"error": "not found"}))
+            except _AlreadyExists as e:
                 self._reply(409, json.dumps({"error": str(e)}))
-                return
-            self._reply(201, json.dumps({"name": name, "weight": weight}))
+            except (ValueError, TypeError, KeyError, AttributeError, json.JSONDecodeError) as e:
+                self._reply(400, json.dumps({"error": str(e)}))
 
         def do_DELETE(self):  # noqa: N802
-            prefix = "/apis/v1alpha1/queues/"
-            if not self.path.startswith(prefix):
-                self._reply(404, json.dumps({"error": "not found"}))
-                return
-            name = self.path[len(prefix):]
+            parts = self.path.strip("/").split("/")
             try:
-                server.store.delete_queue(name)
+                if parts[:2] != ["apis", "v1alpha1"] or len(parts) < 4:
+                    self._reply(404, json.dumps({"error": "not found"}))
+                    return
+                kind, rest = parts[2], parts[3:]
+                if kind == "queues" and len(rest) == 1:
+                    server.store.delete_queue(rest[0])
+                elif kind == "nodes" and len(rest) == 1:
+                    server.store.delete_node(rest[0])
+                elif kind == "pods" and len(rest) == 2:
+                    server.store.delete_pod(rest[0], rest[1])
+                elif kind == "podgroups" and len(rest) == 2:
+                    server.store.delete_pod_group(rest[0], rest[1])
+                else:
+                    self._reply(404, json.dumps({"error": "not found"}))
+                    return
             except KeyError as e:
                 self._reply(404, json.dumps({"error": str(e)}))
                 return
-            self._reply(200, json.dumps({"deleted": name}))
+            self._reply(200, json.dumps({"deleted": "/".join(parts[3:])}))
 
     return Handler
 
