@@ -47,6 +47,11 @@ KINDS = (PODS, NODES, POD_GROUPS, QUEUES, PDBS, PRIORITY_CLASSES)
 _CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES}
 
 
+class AlreadyExists(KeyError):
+    """create() of a key already present — typed so API layers can map
+    it to HTTP 409 without string-matching the message."""
+
+
 def obj_key(kind: str, obj: Any) -> str:
     meta = obj.metadata
     if kind in _CLUSTER_SCOPED:
@@ -156,7 +161,7 @@ class ClusterStore:
         with self._lock:
             ks = self._ks(kind)
             if key in ks.objects:
-                raise KeyError(f"{kind} {key!r} already exists")
+                raise AlreadyExists(f"{kind} {key!r} already exists")
             ks.objects[key] = obj
             self._events.append(("add", list(ks.handlers), None, obj))
         log.V(4).infof("store: created %s %s", kind, key)

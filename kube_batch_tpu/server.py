@@ -14,16 +14,20 @@ exposes:
 - ``GET|POST /apis/v1alpha1/queues`` and
   ``DELETE /apis/v1alpha1/queues/<name>`` — the queue CRD surface the
   reference CLI talks to (pkg/cli/queue);
-- ``GET|POST /apis/v1alpha1/pods`` / ``nodes`` / ``podgroups`` and
-  ``DELETE /apis/v1alpha1/pods/<ns>/<name>`` (`nodes/<name>`,
-  ``podgroups/<ns>/<name>``) — the workload-ingestion surface an external
-  control plane uses to feed the in-process cluster (the list/watch half
-  the reference gets from the Kubernetes API server; here creations fan
-  out to the cache's event handlers through the store).
+- ``GET|POST /apis/v1alpha1/pods`` / ``nodes`` / ``podgroups`` /
+  ``priorityclasses`` / ``poddisruptionbudgets`` and the matching
+  ``DELETE`` routes — the workload-ingestion surface an external control
+  plane uses to feed the in-process cluster (the list/watch half the
+  reference gets from the Kubernetes API server; here creations fan out
+  to the cache's event handlers through the store). Pod ingestion also
+  stands in for the k8s admission controller: a pod without an explicit
+  priority gets it resolved from its named PriorityClass or the global
+  default class, matching what kube-batch reads pre-resolved from
+  pod.Spec.Priority upstream.
 
 Pod JSON: ``{"name", "namespace", "group", "requests": {"cpu": 1,
-"memory": "512Mi", ...scalars}, "priority", "labels", "node_selector",
-"node_name", "phase", "scheduler_name"}``. Node JSON: ``{"name",
+"memory": "512Mi", ...scalars}, "priority", "priority_class_name",
+"labels", "node_selector", "node_name", "phase", "scheduler_name"}``. Node JSON: ``{"name",
 "allocatable": {...}, "labels"}``. PodGroup JSON: ``{"name",
 "namespace", "queue", "min_member"}``.
 
@@ -48,6 +52,7 @@ from typing import Optional
 from kube_batch_tpu import log, metrics, version
 from kube_batch_tpu.apis.types import ObjectMeta, Queue, QueueSpec
 from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.cache.store import AlreadyExists
 from kube_batch_tpu.scheduler import Scheduler
 
 DEFAULT_SCHEDULER_NAME = "kube-batch-tpu"
@@ -85,10 +90,6 @@ class LeaderElector:
             fcntl.flock(self._fh, fcntl.LOCK_UN)
             self._fh.close()
             self._fh = None
-
-
-class _AlreadyExists(Exception):
-    """Create of an object whose key is already in the store (HTTP 409)."""
 
 
 def _make_handler(server: "SchedulerServer"):
@@ -148,6 +149,27 @@ def _make_handler(server: "SchedulerServer"):
                     for g in server.store.list("podgroups")
                 ]
                 self._reply(200, json.dumps({"items": pgs}))
+            elif self.path == "/apis/v1alpha1/priorityclasses":
+                pcs = [
+                    {
+                        "name": pc.name,
+                        "value": pc.value,
+                        "global_default": pc.global_default,
+                    }
+                    for pc in server.store.list("priorityclasses")
+                ]
+                self._reply(200, json.dumps({"items": pcs}))
+            elif self.path == "/apis/v1alpha1/poddisruptionbudgets":
+                pdbs = [
+                    {
+                        "namespace": b.metadata.namespace,
+                        "name": b.name,
+                        "min_available": b.min_available,
+                        "selector": b.selector,
+                    }
+                    for b in server.store.list("poddisruptionbudgets")
+                ]
+                self._reply(200, json.dumps({"items": pdbs}))
             else:
                 self._reply(404, json.dumps({"error": "not found"}))
 
@@ -173,6 +195,8 @@ def _make_handler(server: "SchedulerServer"):
                         raise ValueError(f"missing required field {key!r}")
                     return default
                 val = body[key]
+                if isinstance(val, bool) and typ is not bool:
+                    raise ValueError(f"field {key!r} must be {typ.__name__}, got bool")
                 if typ is int and isinstance(val, (int, str)):
                     return int(val)
                 if not isinstance(val, typ):
@@ -184,6 +208,11 @@ def _make_handler(server: "SchedulerServer"):
             def resource_list(d) -> dict:
                 if not isinstance(d, dict):
                     raise ValueError("resource list must be an object")
+                for k, v in d.items():
+                    if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+                        raise ValueError(
+                            f"resource {k!r} must be a number or quantity string"
+                        )
                 # k8s-style quantity strings ("8Gi", "500m") -> floats
                 return build_resource_list(
                     cpu=d.get("cpu", 0),
@@ -191,10 +220,6 @@ def _make_handler(server: "SchedulerServer"):
                     pods=int(d.get("pods", 0)),
                     **{k: v for k, v in d.items() if k not in ("cpu", "memory", "pods")},
                 )
-
-            def ensure_new(kind: str, key: str) -> None:
-                if server.store.get(kind, key) is not None:
-                    raise _AlreadyExists(f"{kind} {key!r} already exists")
 
             try:
                 body = self._read_body()
@@ -205,7 +230,6 @@ def _make_handler(server: "SchedulerServer"):
                     weight = field(body, "weight", int, 1)
                     if weight < 1:
                         raise ValueError("weight must be >= 1")
-                    ensure_new("queues", name)
                     server.store.create_queue(
                         Queue(metadata=ObjectMeta(name=name), spec=QueueSpec(weight=weight))
                     )
@@ -227,7 +251,33 @@ def _make_handler(server: "SchedulerServer"):
                             body, "scheduler_name", str, server.cache.scheduler_name
                         ),
                     )
-                    ensure_new("pods", f"{namespace}/{name}")
+                    pod.priority_class_name = field(body, "priority_class_name", str, "")
+                    # Admission-controller stand-in: kube-batch reads
+                    # pod.Spec.Priority already resolved by k8s admission
+                    # from the PriorityClass; with no admission layer here,
+                    # ingestion resolves it (named class, else the global
+                    # default class).
+                    if pod.priority is None:
+                        pc = None
+                        if pod.priority_class_name:
+                            pc = server.store.get(
+                                "priorityclasses", pod.priority_class_name
+                            )
+                            if pc is None:
+                                raise ValueError(
+                                    f"unknown priority class {pod.priority_class_name!r}"
+                                )
+                        else:
+                            pc = next(
+                                (
+                                    c
+                                    for c in server.store.list("priorityclasses")
+                                    if c.global_default
+                                ),
+                                None,
+                            )
+                        if pc is not None:
+                            pod.priority = pc.value
                     server.store.create_pod(pod)
                     self._reply(
                         201, json.dumps({"namespace": pod.namespace, "name": pod.name})
@@ -239,7 +289,6 @@ def _make_handler(server: "SchedulerServer"):
                         resource_list(body.get("allocatable", {})),
                         labels=field(body, "labels", dict, None),
                     )
-                    ensure_new("nodes", name)
                     server.store.create_node(node)
                     self._reply(201, json.dumps({"name": node.name}))
                 elif self.path == "/apis/v1alpha1/podgroups":
@@ -251,17 +300,41 @@ def _make_handler(server: "SchedulerServer"):
                         queue=field(body, "queue", str, server.cache.default_queue),
                         min_member=field(body, "min_member", int, 1),
                     )
-                    ensure_new("podgroups", f"{namespace}/{name}")
                     server.store.create_pod_group(pg)
                     self._reply(
                         201,
                         json.dumps({"namespace": pg.metadata.namespace, "name": pg.name}),
                     )
+                elif self.path == "/apis/v1alpha1/priorityclasses":
+                    from kube_batch_tpu.apis.types import PriorityClass
+
+                    name = field(body, "name", str, None, required=True)
+                    pc = PriorityClass(
+                        metadata=ObjectMeta(name=name, uid=f"pc-{name}"),
+                        value=field(body, "value", int, 0),
+                        global_default=field(body, "global_default", bool, False),
+                    )
+                    server.store.create_priority_class(pc)
+                    self._reply(201, json.dumps({"name": name, "value": pc.value}))
+                elif self.path == "/apis/v1alpha1/poddisruptionbudgets":
+                    from kube_batch_tpu.apis.types import PodDisruptionBudget
+
+                    name = field(body, "name", str, None, required=True)
+                    namespace = field(body, "namespace", str, "default")
+                    pdb = PodDisruptionBudget(
+                        metadata=ObjectMeta(
+                            name=name, namespace=namespace, uid=f"pdb-{namespace}-{name}"
+                        ),
+                        min_available=field(body, "min_available", int, 0),
+                        selector=field(body, "selector", dict, None) or {},
+                    )
+                    server.store.create_pdb(pdb)
+                    self._reply(201, json.dumps({"namespace": namespace, "name": name}))
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
-            except _AlreadyExists as e:
-                self._reply(409, json.dumps({"error": str(e)}))
-            except (ValueError, TypeError, KeyError, AttributeError, json.JSONDecodeError) as e:
+            except AlreadyExists as e:
+                self._reply(409, json.dumps({"error": str(e.args[0])}))
+            except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
                 self._reply(400, json.dumps({"error": str(e)}))
 
         def do_DELETE(self):  # noqa: N802
@@ -279,6 +352,10 @@ def _make_handler(server: "SchedulerServer"):
                     server.store.delete_pod(rest[0], rest[1])
                 elif kind == "podgroups" and len(rest) == 2:
                     server.store.delete_pod_group(rest[0], rest[1])
+                elif kind == "priorityclasses" and len(rest) == 1:
+                    server.store.delete_priority_class(rest[0])
+                elif kind == "poddisruptionbudgets" and len(rest) == 2:
+                    server.store.delete("poddisruptionbudgets", f"{rest[0]}/{rest[1]}")
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
                     return

@@ -367,6 +367,7 @@ def test_ingestion_rejects_type_poisoned_pods(server):
             return e.code
 
     assert post("/apis/v1alpha1/pods", {"name": "p", "priority": "high"}) == 400
+    assert post("/apis/v1alpha1/pods", {"name": "p", "priority": True}) == 400  # bool
     assert post("/apis/v1alpha1/pods", {"name": "p", "labels": "x"}) == 400
     assert post("/apis/v1alpha1/pods", {"name": "p", "requests": "2cpu"}) == 400
     assert post("/apis/v1alpha1/pods", {"priority": 5}) == 400  # no name
@@ -374,3 +375,64 @@ def test_ingestion_rejects_type_poisoned_pods(server):
     # int-as-string priority is coerced, not rejected
     assert post("/apis/v1alpha1/pods", {"name": "ok", "priority": "5"}) == 201
     assert post("/apis/v1alpha1/pods", {"name": "ok", "priority": 5}) == 409  # dup
+
+
+def test_pdb_and_priorityclass_ingestion(server):
+    """PDBs (legacy shadow-gang source) and PriorityClasses round-trip
+    over HTTP and actually steer scheduling: the priority class resolves
+    the pod's priority through the cache."""
+    import urllib.request
+
+    addr = f"http://127.0.0.1:{server.listen_port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{addr}{path}", data=json.dumps(payload).encode(), method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status
+
+    assert post("/apis/v1alpha1/priorityclasses", {"name": "gold", "value": 9}) == 201
+    assert (
+        post(
+            "/apis/v1alpha1/poddisruptionbudgets",
+            {"name": "web-pdb", "min_available": 2, "selector": {"app": "web"}},
+        )
+        == 201
+    )
+    _, body = http_get(server, "/apis/v1alpha1/priorityclasses")
+    assert json.loads(body)["items"] == [
+        {"name": "gold", "value": 9, "global_default": False}
+    ]
+    _, body = http_get(server, "/apis/v1alpha1/poddisruptionbudgets")
+    assert json.loads(body)["items"][0]["min_available"] == 2
+
+    # PDB delete route (shadow-gang constraints must be removable)
+    req = urllib.request.Request(
+        f"{addr}/apis/v1alpha1/poddisruptionbudgets/default/web-pdb", method="DELETE"
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 200
+    _, body = http_get(server, "/apis/v1alpha1/poddisruptionbudgets")
+    assert json.loads(body)["items"] == []
+
+    # a pod using the class gets its priority resolved in the snapshot
+    post("/apis/v1alpha1/nodes", {"name": "pn0", "allocatable": {"cpu": 2, "memory": "4Gi", "pods": 10}})
+    post(
+        "/apis/v1alpha1/pods",
+        {
+            "name": "gold-pod",
+            "requests": {"cpu": 1, "memory": "1Gi"},
+            "priority_class_name": "gold",
+        },
+    )
+    wait_until(
+        lambda: (server.store.get_pod("default", "gold-pod") or build_pod()).node_name
+        == "pn0",
+        what="gold pod bound",
+    )
+    snap = server.cache.snapshot()
+    task = next(
+        t for j in snap.jobs.values() for t in j.tasks.values() if t.name == "gold-pod"
+    )
+    assert task.priority == 9
