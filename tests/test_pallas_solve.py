@@ -146,3 +146,80 @@ def test_more_tasks_than_capacity():
     lax_state, pallas_state = solve_both(synthetic(300, 2, tasks_per_job=10))
     assert_states_equal(lax_state, pallas_state)
     assert int(pallas_state.step) < 300
+
+
+def test_supported_envelope_edges():
+    """Out-of-envelope snapshots must be detected so the action routes to
+    the XLA kernel instead of failing in Mosaic."""
+    import numpy as np
+
+    from kube_batch_tpu.ops import pallas_solve
+
+    def base(T=64, N=16, R=2, P=1, GT=1):
+        return {
+            "task_req": np.zeros((T, R), np.float32),
+            "task_ports": np.zeros((T, P), bool),
+            "compat": np.zeros((GT, 4), bool),
+            "node_idle": np.zeros((N, R), np.float32),
+            "job_min": np.zeros(8, np.int32),
+        }
+
+    assert pallas_solve.supported(base())
+    assert not pallas_solve.supported(base(R=9))  # resource rank beyond R8
+    assert not pallas_solve.supported(base(P=40))  # > 31 distinct host ports
+    # compat expansion past the VMEM budget (GT x N too large)
+    assert not pallas_solve.supported(base(GT=4096, N=8192))
+
+
+def test_many_scalar_resources_falls_back_to_lax(monkeypatch):
+    """A cluster with 7+ distinct scalar resources (R > 8) runs the XLA
+    kernel via the action and still matches serial."""
+    import numpy as np
+
+    from kube_batch_tpu.actions.xla_allocate import XlaAllocateAction
+    from kube_batch_tpu.testing import (
+        build_cluster,
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+
+    scalars = {f"vendor{i}.com/dev": 2 for i in range(7)}
+
+    def mk():
+        pods = [
+            build_pod(
+                name=f"p{i}",
+                group_name="pg",
+                req=build_resource_list(cpu=1, memory="1Gi", **scalars),
+            )
+            for i in range(3)
+        ]
+        nodes = [
+            build_node(
+                f"n{i}", build_resource_list(cpu=4, memory="8Gi", pods=10, **scalars)
+            )
+            for i in range(2)
+        ]
+        return build_cluster(
+            pods, nodes, [build_pod_group("pg", min_member=1)], [build_queue("default")]
+        )
+
+    monkeypatch.setenv("KBT_PALLAS", "interpret")  # would use pallas if eligible
+
+    def run(action):
+        cache = FakeCache(mk())
+        ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+        if action == "serial":
+            from kube_batch_tpu.actions.allocate import AllocateAction
+
+            AllocateAction().execute(ssn)
+        else:
+            XlaAllocateAction(dtype=np.float32).execute(ssn)
+        binds = dict(cache.binder.binds)
+        close_session(ssn)
+        return binds
+
+    assert run("xla") == run("serial") != {}
