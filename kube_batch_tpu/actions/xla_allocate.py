@@ -52,36 +52,9 @@ from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import Session
 
+from kube_batch_tpu.actions.envelope import kernel_supported as _kernel_supported
+
 log = logging.getLogger("kube_batch_tpu.actions.xla_allocate")
-
-# Plugins whose session hooks the kernel models exactly (priority/gang
-# ordering + barrier, drf/proportion shares, predicates masks, nodeorder
-# score) or that register nothing the allocate path consults
-# (conformance: preempt/reclaim only).
-_SUPPORTED_PLUGINS = {
-    "priority",
-    "gang",
-    "conformance",
-    "drf",
-    "predicates",
-    "proportion",
-    "nodeorder",
-    "tensorscore",  # nodeorder's scores served as vectors — same policy
-}
-
-# The per-plugin enable flags the conf schema knows (conf/__init__.py);
-# the kernel models the all-defaults (True) configuration of each.
-_ENABLE_FLAGS = (
-    "enabled_job_order",
-    "enabled_job_ready",
-    "enabled_job_pipelined",
-    "enabled_task_order",
-    "enabled_preemptable",
-    "enabled_reclaimable",
-    "enabled_queue_order",
-    "enabled_predicate",
-    "enabled_node_order",
-)
 
 
 def _nodeorder_weights(ssn: Session) -> tuple[float, float, float, float]:
@@ -106,32 +79,6 @@ def _nodeorder_weights(ssn: Session) -> tuple[float, float, float, float]:
                     args.get_int(POD_AFFINITY_WEIGHT, 1),
                 )
     return 0.0, 0.0, 0.0, 0.0
-
-
-def _kernel_supported(ssn: Session) -> bool:
-    """True when the tiers describe exactly the policy the kernel models:
-    the job-order chain must read priority -> gang -> (drf), all enable
-    flags at their defaults, predicates present for the masks. The
-    reference's default conf (util.go:31-42) passes. Anything else would
-    make the kernel silently diverge from the serial oracle, so it falls
-    back."""
-    order: list[str] = []
-    for tier in ssn.tiers:
-        for option in tier.plugins:
-            if option.name not in _SUPPORTED_PLUGINS:
-                return False
-            if not all(getattr(option, flag, True) for flag in _ENABLE_FLAGS):
-                return False
-            order.append(option.name)
-    if "priority" not in order or "gang" not in order or "predicates" not in order:
-        return False
-    if order.index("priority") > order.index("gang"):
-        return False
-    # drf's job-order key sits after priority and gang in the kernel's
-    # selection tuple; a conf ordering drf earlier would chain differently.
-    if "drf" in order and order.index("drf") < order.index("gang"):
-        return False
-    return True
 
 
 class XlaAllocateAction(Action):
@@ -483,6 +430,10 @@ class _Replayer:
         self.apply_one(row, nrow, kind)
         self.replayed = pos + 1
         self._flush_nodes()
+        # Invalidate state_seq-keyed score memos (nodeorder/tensorscore):
+        # the replay mutates node accounting without going through
+        # ssn.allocate/pipeline, which are what normally bump the seq.
+        self.ssn.state_seq += 1
 
     def apply_upto(self, assign_pos, assigned_node, assigned_kind, step: int) -> None:
         """Apply all events with replayed <= pos < step — the same net
@@ -502,6 +453,9 @@ class _Replayer:
         self.replayed = step
         if rows.size == 0:
             return
+        # Same memo invalidation as apply_immediate: bulk replay mutates
+        # node.used/tasks behind the session's back.
+        self.ssn.state_seq += 1
         rows = rows[np.argsort(assign_pos[rows], kind="stable")]
         nrows = assigned_node[rows]
         kinds = assigned_kind[rows]

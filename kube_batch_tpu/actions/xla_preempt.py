@@ -52,7 +52,15 @@ class XlaPreemptAction(Action):
         return "xla_preempt"
 
     def execute(self, ssn: Session) -> None:
-        from kube_batch_tpu.actions.preempt import run_preempt, serial_candidates
+        from kube_batch_tpu.actions.envelope import scan_supported
+        from kube_batch_tpu.actions.preempt import PreemptAction, run_preempt, serial_candidates
+
+        if not scan_supported(ssn):
+            # VectorScan hardcodes the built-in predicate set and the
+            # nodeorder/tensorscore score model; an unmodeled plugin in
+            # the conf would silently diverge from the serial oracle.
+            PreemptAction().execute(ssn)
+            return
 
         scan = VectorScan(ssn)
 

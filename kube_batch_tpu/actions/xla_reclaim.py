@@ -27,7 +27,14 @@ class XlaReclaimAction(Action):
         return "xla_reclaim"
 
     def execute(self, ssn: Session) -> None:
-        from kube_batch_tpu.actions.reclaim import run_reclaim, serial_feasible
+        from kube_batch_tpu.actions.envelope import scan_supported
+        from kube_batch_tpu.actions.reclaim import ReclaimAction, run_reclaim, serial_feasible
+
+        if not scan_supported(ssn):
+            # Same envelope rule as xla_preempt: unmodeled predicate or
+            # node-order plugins fall back to the serial action.
+            ReclaimAction().execute(ssn)
+            return
 
         scan = VectorScan(ssn)
 

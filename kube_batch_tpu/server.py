@@ -387,9 +387,12 @@ class SchedulerServer:
             self.cache, scheduler_conf=scheduler_conf, schedule_period=schedule_period
         )
         host, _, port = listen_address.rpartition(":")
-        # ":8080" means all interfaces, matching the reference's
-        # net.Listen semantics for ListenAddress (app/options/options.go)
-        self.httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _make_handler(self))
+        # Unlike the reference's ListenAddress (app/options/options.go),
+        # which only serves metrics/healthz, this port also carries the
+        # unauthenticated mutating workload API — so a bare ":8080"
+        # defaults to loopback; binding other interfaces requires naming
+        # them explicitly (e.g. "0.0.0.0:8080").
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), _make_handler(self))
         self.httpd.daemon_threads = True
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -452,7 +455,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--listen-address",
         default=DEFAULT_LISTEN_ADDRESS,
-        help="HTTP listen address for /metrics and the queue API",
+        help="HTTP listen address for /metrics and the workload/queue API; "
+        "a bare ':PORT' binds loopback only — this port carries an "
+        "unauthenticated mutating API, so name an interface (e.g. "
+        "'0.0.0.0:8080') to expose it",
     )
     p.add_argument(
         "--leader-elect",

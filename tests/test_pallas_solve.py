@@ -158,10 +158,16 @@ def test_supported_envelope_edges():
     def base(T=64, N=16, R=2, P=1, GT=1):
         return {
             "task_req": np.zeros((T, R), np.float32),
+            "task_res": np.zeros((T, R), np.float32),
+            "task_gid": np.zeros(T, np.int32),
+            "task_has_sc": np.zeros(T, bool),
+            "task_res_has_sc": np.zeros(T, bool),
+            "task_host_only": np.zeros(T, bool),
             "task_ports": np.zeros((T, P), bool),
             "compat": np.zeros((GT, 4), bool),
             "node_idle": np.zeros((N, R), np.float32),
             "job_min": np.zeros(8, np.int32),
+            "queue_rank": np.zeros(2, np.int32),
         }
 
     assert pallas_solve.supported(base())

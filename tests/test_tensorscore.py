@@ -76,6 +76,82 @@ def test_preempt_with_tensorscore():
         assert t == n, f"seed {seed} diverged"
 
 
+def test_xla_allocate_tensorscore_multi_pause_pod_affinity():
+    """Round-3 advisor finding: xla_allocate's bulk replay mutates
+    node.used without bumping ssn.state_seq, so with 2+ host-stepped
+    pod-affinity pauses tensorscore scored the later pause with stale
+    Used vectors. Two required-affinity pods separated by filler
+    assignments must land exactly where the serial scorer puts them."""
+    from kube_batch_tpu.apis.types import Affinity, PodAffinityTerm, PodPhase
+    from kube_batch_tpu.testing import (
+        build_cluster,
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+
+    def mk():
+        pods, groups = [], []
+        # anchors make n0/n1 eligible for the required-affinity pods
+        for i in (0, 1):
+            pods.append(
+                build_pod(
+                    name=f"anchor{i}",
+                    node_name=f"n{i}",
+                    phase=PodPhase.RUNNING,
+                    req=build_resource_list(cpu=1, memory="128Mi"),
+                    labels={"app": "db"},
+                )
+            )
+
+        def gang(name, pod, ts):
+            pod.metadata.creation_timestamp = ts
+            pg = build_pod_group(name, min_member=1)
+            pg.metadata.creation_timestamp = ts
+            pods.append(pod)
+            groups.append(pg)
+
+        aff1 = build_pod(
+            name="aff1", group_name="g-aff1", req=build_resource_list(cpu=1, memory="256Mi")
+        )
+        aff1.affinity = Affinity(
+            pod_affinity_required=[PodAffinityTerm(label_selector={"app": "db"})]
+        )
+        gang("g-aff1", aff1, 0.0)
+        # fillers shift the least-requested balance between n0 and n1
+        # after aff1's pause — a stale Used memo misses their effect
+        for i in range(4):
+            gang(
+                f"g-fill{i}",
+                build_pod(
+                    name=f"fill{i}",
+                    group_name=f"g-fill{i}",
+                    req=build_resource_list(cpu=2, memory="2Gi"),
+                ),
+                1.0 + i,
+            )
+        aff2 = build_pod(
+            name="aff2", group_name="g-aff2", req=build_resource_list(cpu=1, memory="256Mi")
+        )
+        aff2.affinity = Affinity(
+            pod_affinity_required=[PodAffinityTerm(label_selector={"app": "db"})]
+        )
+        gang("g-aff2", aff2, 10.0)
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=8, memory="8Gi", pods=20))
+            for i in range(3)
+        ]
+        return build_cluster(pods, nodes, groups, [build_queue("default")])
+
+    serial = run("allocate", mk(), "tensorscore")
+    vector = run("xla_allocate", mk(), "tensorscore")
+    assert vector == serial
+    oracle = run("allocate", mk(), "nodeorder")
+    assert serial == oracle
+
+
 def test_xla_allocate_accepts_tensorscore_conf():
     """The kernel envelope treats tensorscore as nodeorder (same scores):
     xla_allocate under a tensorscore conf == serial allocate under it."""

@@ -212,3 +212,29 @@ def test_pod_affinity_preemptor_takes_serial_path():
         return cluster
 
     assert_equivalent(mk)
+
+
+def test_out_of_envelope_conf_falls_back_serial():
+    """A conf whose plugin set the scan does not model — here one without
+    the predicates plugin (the serial chain would treat every node as
+    feasible while the scan still applies its hardwired masks) — must
+    route xla_preempt/xla_reclaim through the serial actions."""
+    no_predicates = """
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: nodeorder
+"""
+    tiers = parse_scheduler_conf(no_predicates).tiers
+
+    def run(action_name):
+        cache = FakeCache(gen_contended_cluster(5))
+        ssn = open_session(cache, tiers)
+        get_action(action_name).execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds), list(cache.evictor.evicts)
+
+    assert run("xla_preempt") == run("preempt")
+    assert run("xla_reclaim") == run("reclaim")
