@@ -89,6 +89,9 @@ class XlaAllocateAction(Action):
         self._warned_f32 = False
         # Wall-clock split of the last execute() (bench.py reads this).
         self.last_timings: dict[str, float] = {}
+        # Devices in the mesh the last execute() resolved (1 = single-chip);
+        # the driver dryrun asserts on this to prove the sharded path ran.
+        self.last_mesh_size = 1
 
     @property
     def name(self) -> str:
@@ -150,8 +153,9 @@ class XlaAllocateAction(Action):
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
+        mesh = self._resolve_mesh(ssn)
         solve_fn = self._make_solver(
-            arrays, enable_drf, enable_proportion, dtype, enc.interpod_active
+            arrays, enable_drf, enable_proportion, dtype, enc.interpod_active, mesh
         )
 
         t0 = _time.perf_counter()
@@ -190,6 +194,72 @@ class XlaAllocateAction(Action):
             "replay_s": _time.perf_counter() - t0,
         }
 
+    def _resolve_mesh(self, ssn: Session):
+        """Conf-selected device mesh for the solve, or None (single-chip).
+
+        `actionArguments: {xla_allocate: {mesh: ...}}` (env KBT_MESH as
+        the conf-less override): ``off``/``0``/``1`` -> single chip;
+        ``auto`` -> every visible device; an integer -> that many; an
+        explicit ``backend:count`` (e.g. ``cpu:8``) pins the JAX backend
+        — how the driver/tests exercise the multi-chip path on a virtual
+        CPU mesh when the ambient default backend is a single TPU. The
+        mesh size is clamped to the largest power of two available so it
+        always divides the encoder's power-of-two node buckets. The
+        resolved size lands in `self.last_mesh_size` so callers can
+        verify the sharded path actually engaged."""
+        self.last_mesh_size = 1
+        spec = ssn.action_arguments.get(self.name, {}).get(
+            "mesh", os.environ.get("KBT_MESH", "")
+        )
+        spec = (spec or "").strip().lower()
+        if spec in ("", "off", "none", "0", "1"):
+            return None
+        import jax as _jax
+
+        backend = None
+        if ":" in spec:
+            backend, spec = spec.split(":", 1)
+        try:
+            devices = _jax.devices(backend)
+        except RuntimeError:
+            log.warning(
+                "mesh backend %r unavailable; running single-chip", backend
+            )
+            return None
+        if spec == "auto":
+            want = len(devices)
+        else:
+            try:
+                want = int(spec)
+            except ValueError:
+                # A bad conf value must not kill the scheduling loop
+                # (scheduler.py's rule for parse errors applies to
+                # values too) — degrade to single-chip and say so.
+                log.warning(
+                    "unrecognized mesh spec %r; running single-chip", spec
+                )
+                return None
+        if want < 1:
+            log.warning("mesh=%s is not a device count; running single-chip", spec)
+            return None
+        n = min(want, len(devices))
+        n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+        if n <= 1:
+            if spec != "auto" and want > 1:
+                log.warning(
+                    "mesh=%s requested but only %d device(s) visible; "
+                    "running single-chip",
+                    spec,
+                    len(devices),
+                )
+            return None
+        if n != want and spec != "auto":
+            log.warning("mesh=%s clamped to %d devices (pow2, available)", spec, n)
+        from kube_batch_tpu.parallel import make_mesh
+
+        self.last_mesh_size = n
+        return make_mesh(n, devices=devices[:n])
+
     def _make_solver(
         self,
         arrays,
@@ -197,15 +267,59 @@ class XlaAllocateAction(Action):
         enable_proportion: bool,
         dtype,
         interpod_active: bool = False,
+        mesh=None,
     ):
-        """Pick the device solve: the fused Pallas kernel on TPU-class
-        backends (float32, in-envelope snapshots), else the XLA
-        `lax.while_loop` kernel. `KBT_PALLAS=0` forces the XLA kernel;
-        `KBT_PALLAS=interpret` runs the Pallas kernel in interpreter mode
-        (CPU parity tests). Snapshots with live InterPodAffinity scores
-        use the XLA kernel — its pod_sc input refreshes between resumes,
-        while the Pallas solver packs statics once."""
+        """Pick the device solve: with a conf-selected multi-chip mesh,
+        the GSPMD node-axis-sharded XLA kernel (parallel.ShardedSolver);
+        single-chip, the fused Pallas kernel on TPU-class backends
+        (float32, in-envelope snapshots), else the XLA `lax.while_loop`
+        kernel. `KBT_PALLAS=0` forces the XLA kernel; `KBT_PALLAS=interpret`
+        runs the Pallas kernel in interpreter mode (CPU parity tests).
+        Snapshots with live InterPodAffinity scores use the XLA kernel —
+        its pod_sc input refreshes between resumes, while the Pallas
+        solver packs statics once."""
         from kube_batch_tpu.ops.kernels import solve_allocate_state
+
+        if mesh is not None:
+            from kube_batch_tpu.parallel import ShardedSolver
+
+            solver = None
+            try:
+                solver = ShardedSolver(
+                    arrays, mesh, enable_drf=enable_drf,
+                    enable_proportion=enable_proportion,
+                )
+                log.info(
+                    "solving with node-axis-sharded XLA kernel over a "
+                    "%d-device mesh", mesh.devices.size,
+                )
+            except Exception:
+                log.exception(
+                    "sharded solver init failed; using single-chip path"
+                )
+            if solver is not None:
+                sharded = solver
+
+                def solve_sharded(st):
+                    # First solve still traces/compiles lazily; fall back
+                    # to the single-chip XLA kernel on failure rather
+                    # than losing the cycle.
+                    nonlocal sharded
+                    if sharded is not None:
+                        try:
+                            return sharded.solve(st)
+                        except Exception:
+                            log.exception(
+                                "sharded solve failed; falling back to "
+                                "single-chip XLA kernel"
+                            )
+                            sharded = None
+                    return solve_allocate_state(
+                        arrays, st, enable_drf=enable_drf,
+                        enable_proportion=enable_proportion,
+                    )
+
+                return solve_sharded
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None

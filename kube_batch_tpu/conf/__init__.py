@@ -79,10 +79,20 @@ class Tier:
 
 @dataclass
 class SchedulerConfiguration:
-    """reference scheduler_conf.go:20-25."""
+    """reference scheduler_conf.go:20-25, plus `action_arguments`: an
+    extension the reference schema does not have (its actions take no
+    conf arguments) carrying per-action knobs — e.g. xla_allocate's
+    `mesh` device-mesh selection::
+
+        actions: "enqueue, xla_allocate, backfill"
+        actionArguments:
+          xla_allocate:
+            mesh: auto
+    """
 
     actions: str = ""
     tiers: list[Tier] = field(default_factory=list)
+    action_arguments: dict[str, dict[str, str]] = field(default_factory=dict)
 
 
 # Default conf (reference util.go:31-42).
@@ -112,6 +122,10 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
     (reference util.go:44-63)."""
     data = yaml.safe_load(conf_str) or {}
     conf = SchedulerConfiguration(actions=str(data.get("actions", "")))
+    for action_name, args in (data.get("actionArguments") or {}).items():
+        conf.action_arguments[str(action_name)] = {
+            str(k): str(v) for k, v in (args or {}).items()
+        }
     for tier_data in data.get("tiers") or []:
         tier = Tier()
         for plugin_data in tier_data.get("plugins") or []:
@@ -129,8 +143,8 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
 
 
 def load_scheduler_conf(conf_str: str):
-    """YAML -> ([Action], [Tier]); unknown action names raise
-    (reference util.go:44-73). Imported lazily to avoid a framework
+    """YAML -> ([Action], [Tier], action_arguments); unknown action names
+    raise (reference util.go:44-73). Imported lazily to avoid a framework
     import cycle."""
     from kube_batch_tpu.framework import get_action
 
@@ -144,7 +158,7 @@ def load_scheduler_conf(conf_str: str):
         if action is None:
             raise ValueError(f"failed to find Action {name!r}")
         actions.append(action)
-    return actions, conf.tiers
+    return actions, conf.tiers, conf.action_arguments
 
 
 def read_scheduler_conf(conf_path: str) -> str:

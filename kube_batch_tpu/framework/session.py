@@ -62,6 +62,11 @@ class Session:
         self.nodes: dict[str, NodeInfo] = {}
         self.queues: dict[str, QueueInfo] = {}
         self.tiers: list[Tier] = []
+        # Per-action arguments from the conf's optional `actionArguments`
+        # map (an extension over the reference schema — the reference has
+        # no action-level knobs; ours carries e.g. xla_allocate's device
+        # mesh selection). Keyed by action name.
+        self.action_arguments: dict[str, dict[str, str]] = {}
 
         self.plugins: dict[str, Plugin] = {}
         self.event_handlers: list[EventHandler] = []
@@ -447,12 +452,17 @@ def _job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
     return status
 
 
-def open_session(cache: Cache, tiers: list[Tier]) -> Session:
+def open_session(
+    cache: Cache,
+    tiers: list[Tier],
+    action_arguments: Optional[dict[str, dict[str, str]]] = None,
+) -> Session:
     """Snapshot + plugin instantiation + JobValid gate
     (framework.go:30-51 + session.go:66-119; gate ordering fixed, see
     module docstring)."""
     ssn = Session(cache)
     ssn.tiers = tiers
+    ssn.action_arguments = action_arguments or {}
 
     snapshot = cache.snapshot()
     ssn.jobs = snapshot.jobs

@@ -19,14 +19,18 @@ snapshot occupies 5k/n_devices rows per chip.
 
 from kube_batch_tpu.parallel.sharded import (
     NODE_AXIS_ARRAYS,
+    ShardedSolver,
     make_mesh,
     node_shardings,
     sharded_solve_allocate,
+    state_shardings,
 )
 
 __all__ = [
     "NODE_AXIS_ARRAYS",
+    "ShardedSolver",
     "make_mesh",
     "node_shardings",
     "sharded_solve_allocate",
+    "state_shardings",
 ]

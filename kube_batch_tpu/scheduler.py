@@ -61,6 +61,7 @@ class Scheduler:
         self.schedule_period = schedule_period
         self.actions = []
         self.plugins = []
+        self.action_arguments: dict[str, dict[str, str]] = {}
         self._conf_cache: Optional[str] = None
         self._load_conf()
 
@@ -82,7 +83,9 @@ class Scheduler:
         if conf_str == self._conf_cache:
             return
         try:
-            self.actions, self.plugins = load_scheduler_conf(conf_str)
+            self.actions, self.plugins, self.action_arguments = load_scheduler_conf(
+                conf_str
+            )
             self._conf_cache = conf_str
         except Exception as e:  # noqa: BLE001 - bad conf must not kill the loop
             if self._conf_cache is None:
@@ -109,7 +112,7 @@ class Scheduler:
         cycle_start = time.perf_counter()
         self._load_conf()
 
-        ssn = open_session(self.cache, self.plugins)
+        ssn = open_session(self.cache, self.plugins, self.action_arguments)
         try:
             for action in self.actions:
                 action_start = time.perf_counter()

@@ -17,7 +17,8 @@ from kube_batch_tpu.testing import build_resource_list
 
 class TestConfParse:
     def test_default_conf(self):
-        actions_list, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        actions_list, tiers, action_args = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        assert action_args == {}
         assert [a.name for a in actions_list] == ["allocate", "backfill"]
         assert len(tiers) == 2
         assert [p.name for p in tiers[0].plugins] == ["priority", "gang"]
@@ -52,12 +53,26 @@ tiers:
         assert option.enabled_job_ready is True
         assert option.arguments == {"foo": "7"}
 
+    def test_action_arguments_parsed(self):
+        conf = parse_scheduler_conf(
+            """
+actions: "enqueue, xla_allocate, backfill"
+actionArguments:
+  xla_allocate:
+    mesh: auto
+tiers:
+- plugins:
+  - name: gang
+"""
+        )
+        assert conf.action_arguments == {"xla_allocate": {"mesh": "auto"}}
+
     def test_unknown_action_raises(self):
         with pytest.raises(ValueError):
             load_scheduler_conf('actions: "no-such-action"')
 
     def test_full_pipeline_order(self):
-        actions_list, _ = load_scheduler_conf(
+        actions_list, _, _ = load_scheduler_conf(
             'actions: "enqueue, reclaim, allocate, backfill, preempt"'
         )
         assert [a.name for a in actions_list] == [

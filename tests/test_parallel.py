@@ -91,6 +91,120 @@ tiers:
 """
 
 
+def test_xla_allocate_action_sharded_10k_parity():
+    """VERDICT r3 item 1 done-criterion: the multi-chip path through the
+    REAL action — conf-style actionArguments select an 8-device mesh, the
+    action is fetched from the L4 registry, and at 10k tasks x 1k nodes
+    the sharded run's binds equal the single-chip run's exactly."""
+    from kube_batch_tpu.framework import close_session, get_action
+
+    def run(mesh_spec):
+        cache = FakeCache(multi_queue(10_000, 1000))
+        ssn = open_session(
+            cache,
+            parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers,
+            {"xla_allocate": {"mesh": mesh_spec}},
+        )
+        action = get_action("xla_allocate")
+        action.execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds), action.last_mesh_size
+
+    sharded, mesh_n = run("cpu:8")
+    assert mesh_n == 8, "sharded path did not engage"
+    single, mesh_1 = run("off")
+    assert mesh_1 == 1
+    assert len(sharded) == 10_000
+    assert sharded == single
+
+
+def test_scheduler_conf_mesh_reaches_action():
+    """The actionArguments flow: conf text -> Scheduler -> open_session ->
+    xla_allocate resolves the mesh (2-device virtual CPU)."""
+    from kube_batch_tpu.framework import close_session, get_action
+
+    action_args = parse_scheduler_conf(
+        'actionArguments:\n  xla_allocate:\n    mesh: "cpu:2"\n'
+    ).action_arguments
+
+    def run(args):
+        cache = FakeCache(synthetic(48, 8, seed=5))
+        ssn = open_session(cache, parse_scheduler_conf(TIERS_YAML).tiers, args)
+        action = get_action("xla_allocate")
+        action.execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds), action.last_mesh_size
+
+    sharded, mesh_n = run(action_args)
+    assert mesh_n == 2
+    single, mesh_1 = run({})
+    assert mesh_1 == 1
+    assert sharded == single and len(sharded) > 0
+
+
+def test_sharded_action_pod_affinity_resume_parity():
+    """The segmented pod-affinity hybrid under a mesh: the paused state is
+    gathered to host, serial-stepped, and re-enters the *sharded* resume
+    program — binds must still match the single-chip run."""
+    from kube_batch_tpu.apis.types import Affinity, PodAffinityTerm, PodPhase
+    from kube_batch_tpu.framework import close_session, get_action
+    from kube_batch_tpu.testing import (
+        build_cluster,
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+
+    def mk():
+        anchor = build_pod(
+            name="anchor",
+            node_name="n0",
+            phase=PodPhase.RUNNING,
+            req=build_resource_list(cpu=1, memory="128Mi"),
+            labels={"app": "db"},
+        )
+        pods, groups = [anchor], []
+        for i in range(12):
+            p = build_pod(
+                name=f"p{i}",
+                group_name=f"g{i}",
+                req=build_resource_list(cpu=1, memory="256Mi"),
+            )
+            p.metadata.creation_timestamp = float(i)
+            if i in (4, 9):  # two host-only tasks -> two pause/resume trips
+                p.affinity = Affinity(
+                    pod_affinity_required=[PodAffinityTerm(label_selector={"app": "db"})]
+                )
+            pg = build_pod_group(f"g{i}", min_member=1)
+            pg.metadata.creation_timestamp = float(i)
+            pods.append(p)
+            groups.append(pg)
+        nodes = [
+            build_node(f"n{i}", build_resource_list(cpu=8, memory="8Gi", pods=20))
+            for i in range(4)
+        ]
+        return build_cluster(pods, nodes, groups, [build_queue("default")])
+
+    def run(mesh_spec):
+        cache = FakeCache(mk())
+        ssn = open_session(
+            cache,
+            parse_scheduler_conf(TIERS_YAML).tiers,
+            {"xla_allocate": {"mesh": mesh_spec}},
+        )
+        action = get_action("xla_allocate")
+        action.execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds), action.last_mesh_size
+
+    sharded, mesh_n = run("cpu:4")
+    assert mesh_n == 4
+    single, _ = run("off")
+    assert sharded == single and len(sharded) == 12
+
+
 def test_sharded_solve_10k_class_bucket():
     """Scale-proof (VERDICT r2 item 8): a 10k-task x 1k-node-class bucket
     under the reference's default conf (drf + proportion in the loop
