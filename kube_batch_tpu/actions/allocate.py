@@ -114,7 +114,16 @@ class AllocateAction(Action):
                         "binding task <%s/%s> to node <%s>",
                         task.namespace, task.name, node.name,
                     )
-                    ssn.allocate(task, node.name)
+                    try:
+                        ssn.allocate(task, node.name)
+                    except Exception as e:  # noqa: BLE001
+                        # reference allocate.go:158-161: log and move on —
+                        # a volume-assume or dispatch failure must not
+                        # kill the cycle; the task stays unallocated.
+                        log.errorf(
+                            "Failed to allocate task %s on %s: %s",
+                            task.uid, node.name, e,
+                        )
                 else:
                     # Record the miss, try the releasing pool (allocate.go:162-180).
                     delta = node.idle.clone()

@@ -416,7 +416,18 @@ class XlaAllocateAction(Action):
 
         cur = jrow
         if kind:
-            replay.apply_immediate(row, nrow, kind, int(s.step))
+            try:
+                replay.apply_immediate(row, nrow, kind, int(s.step))
+            except Exception as e:  # noqa: BLE001
+                # Volume assume failed (the first mutation apply_one makes,
+                # so session state is untouched): serial semantics — the
+                # task is consumed unassigned and the loop moves on
+                # (allocate.go:158-161 logs and continues).
+                log.error(
+                    "host step: failed to allocate task %s on %s: %s",
+                    task.uid, node.name, e,
+                )
+                return s._replace(cur=np.int32(cur), it=s.it + np.int32(1))
             res = np.asarray(arrays["task_res"][row], s.idle.dtype)
             s.used[nrow] += res
             if kind == KIND_ALLOCATED:
@@ -728,7 +739,19 @@ class _Replayer:
                 continue
             binding = job.task_status_index.setdefault(TaskStatus.BINDING, {})
             for task in list(allocated.values()):
-                bind_volumes(task)
+                try:
+                    bind_volumes(task)
+                except Exception as e:  # noqa: BLE001
+                    # Same routing as session._dispatch: errTasks resync +
+                    # stop dispatching this gang (the serial path's early
+                    # return from the JobReady loop, session.go:285-295).
+                    log.error(
+                        "failed to bind volumes of %s: %s", task.uid, e
+                    )
+                    resync = getattr(ssn.cache, "resync_task", None)
+                    if resync is not None:
+                        resync(task)
+                    break
                 bind(task, task.node_name)
                 allocated.pop(task.uid, None)
                 task.status = TaskStatus.BINDING

@@ -172,6 +172,9 @@ class Pod:
     priority: Optional[int] = None
     priority_class_name: str = ""
     conditions: list[PodCondition] = field(default_factory=list)
+    # Names of PersistentVolumeClaims this pod mounts (same namespace) —
+    # the slice of pod.spec.volumes the volume binder consults.
+    volumes: list[str] = field(default_factory=list)
 
     @property
     def name(self) -> str:
@@ -329,3 +332,71 @@ class PodDisruptionBudget:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# Volumes (reference wires PV/PVC/StorageClass informers into the k8s
+# volumebinder at cache.go:268-297; interface contract interface.go:46-56).
+# Minimal models: what assume-at-allocate / bind-at-dispatch needs.
+# ---------------------------------------------------------------------------
+
+
+class VolumeBindingMode(str, Enum):
+    IMMEDIATE = "Immediate"
+    WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+class VolumePhase(str, Enum):
+    """PV status.phase (subset) / PVC status.phase."""
+
+    PENDING = "Pending"
+    AVAILABLE = "Available"
+    BOUND = "Bound"
+    RELEASED = "Released"
+    LOST = "Lost"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)  # cluster-scoped
+    provisioner: str = ""
+    volume_binding_mode: VolumeBindingMode = VolumeBindingMode.IMMEDIATE
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolume:
+    """Cluster-scoped. `node_affinity` carries the volume's topology
+    (required node-selector terms, OR-of-terms like pod node affinity);
+    empty means accessible from every node."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity_storage: float = 0.0  # bytes
+    storage_class_name: str = ""
+    node_affinity: list[NodeSelectorTerm] = field(default_factory=list)
+    claim_ref: str = ""  # "namespace/name" of the bound PVC
+    phase: VolumePhase = VolumePhase.AVAILABLE
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: str = ""
+    request_storage: float = 0.0  # bytes (spec.resources.requests[storage])
+    volume_name: str = ""  # spec.volumeName, set when bound
+    phase: VolumePhase = VolumePhase.PENDING
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

@@ -58,7 +58,10 @@ from kube_batch_tpu.cache.store import (
     POD_GROUPS,
     PODS,
     PRIORITY_CLASSES,
+    PVCS,
+    PVS,
     QUEUES,
+    STORAGE_CLASSES,
     ClusterStore,
     EventHandler,
 )
@@ -168,15 +171,225 @@ class StoreStatusUpdater:
 
 
 class NoopVolumeBinder:
-    """Volume hooks are structural no-ops on the in-process store (the
-    reference's defaultVolumeBinder drives the upstream volumebinder,
-    cache.go:168-189)."""
+    """Volume hooks as structural no-ops (the reference test utils'
+    FakeVolumeBinder shape, util/test_utils.go:150-163)."""
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         return None
 
     def bind_volumes(self, task: TaskInfo) -> None:
         return None
+
+
+class VolumeBindingError(Exception):
+    """A pod's claims cannot be satisfied on the chosen node (assume
+    time) or the assumed binding no longer holds (bind time)."""
+
+
+class StoreVolumeBinder:
+    """Assume-at-allocate / bind-at-dispatch volume binder over the
+    in-process store — the role the reference's defaultVolumeBinder +
+    upstream k8s volumebinder play (cache.go:165-189; contract
+    interface.go:46-56; call sites session.go:241-260 and :298-322).
+
+    Mirrors of PVs/PVCs/StorageClasses are fed by store subscriptions
+    (the reference wires the same three informers into newSchedulerCache,
+    cache.go:268-297).
+
+    - `allocate_volumes(task, hostname)` (= AssumePodVolumes): for every
+      claim the pod mounts, verify a bound claim's PV tolerates the node,
+      or pick the smallest Available PV matching class/capacity/topology
+      and record the assumption in-memory. Raises VolumeBindingError when
+      any claim cannot be satisfied — the session leaves the task
+      unallocated, like the serial loop does on AssumePodVolumes error.
+    - `bind_volumes(task)` (= BindPodVolumes): write the assumed
+      bindings through the store (PV.claim_ref + both phases -> Bound).
+      Raises when an assumed PV was claimed or deleted meanwhile; the
+      session routes that through the errTasks resync queue.
+
+    All static binding happens at schedule time regardless of the class's
+    volume_binding_mode (in-process there is no separate PV controller to
+    do Immediate-mode binding earlier); the StorageClass mirror validates
+    that claims name real classes. Dynamic provisioning has no in-process
+    counterpart: any class with no pre-provisioned matching PV fails the
+    assume, exactly like a cluster whose provisioner is down."""
+
+    def __init__(self, store: ClusterStore) -> None:
+        self._store = store
+        self._lock = threading.RLock()
+        self._pvs: dict[str, object] = {}
+        self._pvcs: dict[str, object] = {}
+        self._classes: dict[str, object] = {}
+        # task uid -> {pvc_key: pv_name} assumed (not yet written)
+        self._assumed: dict[str, dict[str, str]] = {}
+        # pv name -> pvc_key reserved by an assumption
+        self._reserved: dict[str, str] = {}
+        for kind, mirror in ((PVS, self._pvs), (PVCS, self._pvcs), (STORAGE_CLASSES, self._classes)):
+            store.add_event_handler(
+                kind,
+                EventHandler(
+                    on_add=lambda obj, m=mirror, k=kind: self._upsert(m, k, obj),
+                    on_update=lambda old, new, m=mirror, k=kind: self._upsert(m, k, new),
+                    on_delete=lambda obj, m=mirror, k=kind: self._remove(m, k, obj),
+                ),
+            )
+
+    def _key(self, kind: str, obj) -> str:
+        from kube_batch_tpu.cache.store import obj_key
+
+        return obj_key(kind, obj)
+
+    def _upsert(self, mirror: dict, kind: str, obj) -> None:
+        with self._lock:
+            mirror[self._key(kind, obj)] = obj
+
+    def _remove(self, mirror: dict, kind: str, obj) -> None:
+        with self._lock:
+            mirror.pop(self._key(kind, obj), None)
+
+    # -- assume (AssumePodVolumes, session.go:241-260) ---------------------
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        claims = getattr(task.pod, "volumes", None)
+        if not claims:
+            task.volume_ready = True
+            return
+        node = self._store.get(NODES, hostname)
+        node_labels = node.metadata.labels if node is not None else {}
+        with self._lock:
+            assumed: dict[str, str] = {}
+            all_bound = True
+            for claim in claims:
+                pvc_key = f"{task.namespace}/{claim}"
+                pvc = self._pvcs.get(pvc_key)
+                if pvc is None:
+                    raise VolumeBindingError(
+                        f"pod <{task.namespace}/{task.name}> mounts unknown "
+                        f"claim <{pvc_key}>"
+                    )
+                if (
+                    pvc.storage_class_name
+                    and pvc.storage_class_name not in self._classes
+                ):
+                    raise VolumeBindingError(
+                        f"claim <{pvc_key}> names unknown storage class "
+                        f"<{pvc.storage_class_name}>"
+                    )
+                if pvc.volume_name:
+                    pv = self._pvs.get(pvc.volume_name)
+                    if pv is None:
+                        raise VolumeBindingError(
+                            f"claim <{pvc_key}> bound to missing volume "
+                            f"<{pvc.volume_name}>"
+                        )
+                    if not self._pv_fits_node(pv, node_labels):
+                        raise VolumeBindingError(
+                            f"volume <{pv.name}> of claim <{pvc_key}> does "
+                            f"not tolerate node <{hostname}>"
+                        )
+                    continue
+                pv = self._find_best_pv(
+                    pvc, pvc_key, node_labels, exclude=set(assumed.values())
+                )
+                if pv is None:
+                    raise VolumeBindingError(
+                        f"no persistent volume satisfies claim <{pvc_key}> "
+                        f"on node <{hostname}>"
+                    )
+                assumed[pvc_key] = pv.name
+                all_bound = False
+            # commit assumptions only when every claim succeeded
+            for pvc_key, pv_name in assumed.items():
+                self._reserved[pv_name] = pvc_key
+            if assumed:
+                self._assumed.setdefault(task.uid, {}).update(assumed)
+            task.volume_ready = all_bound
+
+    def _find_best_pv(self, pvc, pvc_key: str, node_labels: dict, exclude=frozenset()):
+        """Smallest Available PV matching class/capacity/topology, not
+        reserved by another assumption nor picked for a sibling claim of
+        the same pod (`exclude`) — k8s findBestMatchPVForClaim."""
+        from kube_batch_tpu.apis.types import VolumePhase
+
+        best = None
+        for pv in self._pvs.values():
+            if pv.phase != VolumePhase.AVAILABLE or pv.claim_ref:
+                continue
+            if pv.name in exclude:
+                continue
+            reserved_for = self._reserved.get(pv.name)
+            if reserved_for is not None and reserved_for != pvc_key:
+                continue
+            if pv.storage_class_name != pvc.storage_class_name:
+                continue
+            if pv.capacity_storage < pvc.request_storage:
+                continue
+            if not self._pv_fits_node(pv, node_labels):
+                continue
+            if best is None or pv.capacity_storage < best.capacity_storage:
+                best = pv
+        return best
+
+    @staticmethod
+    def _pv_fits_node(pv, node_labels: dict) -> bool:
+        if not pv.node_affinity:
+            return True
+        return any(term.matches(node_labels) for term in pv.node_affinity)
+
+    # -- bind (BindPodVolumes, session.go:298-322) -------------------------
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        from kube_batch_tpu.apis.types import VolumePhase
+
+        with self._lock:
+            # Read, don't pop: a failed bind must keep the assumption
+            # record (and its reservations), or a retry would vacuously
+            # succeed and bind the pod without its volumes. Successful
+            # writes are idempotent on retry (claim_ref == pvc_key
+            # passes the conflict check), so partial failure is safe.
+            assumed = dict(self._assumed.get(task.uid, {}))
+        for pvc_key, pv_name in assumed.items():
+            pv = self._store.get(PVS, pv_name)
+            pvc = self._store.get(PVCS, pvc_key)
+            if pv is None or pvc is None:
+                raise VolumeBindingError(
+                    f"assumed volume <{pv_name}> or claim <{pvc_key}> "
+                    "vanished before bind"
+                )
+            if pv.claim_ref and pv.claim_ref != pvc_key:
+                raise VolumeBindingError(
+                    f"assumed volume <{pv_name}> was claimed by "
+                    f"<{pv.claim_ref}>"
+                )
+            self._store.update_persistent_volume(
+                dataclasses.replace(pv, claim_ref=pvc_key, phase=VolumePhase.BOUND)
+            )
+            self._store.update_persistent_volume_claim(
+                dataclasses.replace(
+                    pvc, volume_name=pv_name, phase=VolumePhase.BOUND
+                )
+            )
+        task.volume_ready = True
+        with self._lock:
+            self._assumed.pop(task.uid, None)
+            for pv_name in assumed.values():
+                self._reserved.pop(pv_name, None)
+
+    # -- rollback (a failed/abandoned assumption must free the PVs) --------
+
+    def forget(self, task_uid: str) -> None:
+        with self._lock:
+            for pv_name in self._assumed.pop(task_uid, {}).values():
+                self._reserved.pop(pv_name, None)
+
+    def reset(self) -> None:
+        """Drop every outstanding assumption. Called at snapshot time:
+        assume/bind both happen synchronously within one session, so
+        anything still assumed when a new session starts belongs to a
+        gang that never dispatched — its PVs must come back."""
+        with self._lock:
+            self._assumed.clear()
+            self._reserved.clear()
 
 
 class SchedulerCache:
@@ -207,7 +420,7 @@ class SchedulerCache:
         self.binder = binder or StoreBinder(store)
         self.evictor = evictor or StoreEvictor(store)
         self.status_updater = status_updater or StoreStatusUpdater(store)
-        self.volume_binder = volume_binder or NoopVolumeBinder()
+        self.volume_binder = volume_binder or StoreVolumeBinder(store)
 
         self._err_tasks = RateLimitingQueue(key_fn=lambda t: t.uid)
         self._deleted_jobs = RateLimitingQueue(key_fn=lambda j: j.uid)
@@ -684,6 +897,9 @@ class SchedulerCache:
     # -- snapshot (reference cache.go:535-585) -----------------------------
 
     def snapshot(self) -> ClusterInfo:
+        reset = getattr(self.volume_binder, "reset", None)
+        if reset is not None:
+            reset()  # assumptions never outlive a session (see reset())
         with self._mutex:
             snapshot = ClusterInfo()
             for name, node in self.nodes.items():

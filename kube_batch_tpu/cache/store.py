@@ -27,11 +27,14 @@ from typing import Any, Callable, Optional
 from kube_batch_tpu import log
 from kube_batch_tpu.apis.types import (
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodDisruptionBudget,
     PodGroup,
     PriorityClass,
     Queue,
+    StorageClass,
 )
 
 PODS = "pods"
@@ -40,11 +43,17 @@ POD_GROUPS = "podgroups"
 QUEUES = "queues"
 PDBS = "poddisruptionbudgets"
 PRIORITY_CLASSES = "priorityclasses"
+PVS = "persistentvolumes"
+PVCS = "persistentvolumeclaims"
+STORAGE_CLASSES = "storageclasses"
 
-KINDS = (PODS, NODES, POD_GROUPS, QUEUES, PDBS, PRIORITY_CLASSES)
+KINDS = (
+    PODS, NODES, POD_GROUPS, QUEUES, PDBS, PRIORITY_CLASSES,
+    PVS, PVCS, STORAGE_CLASSES,
+)
 
 # Kinds whose objects are cluster-scoped (keyed by name, not ns/name).
-_CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES}
+_CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES, PVS, STORAGE_CLASSES}
 
 
 class AlreadyExists(KeyError):
@@ -246,3 +255,30 @@ class ClusterStore:
 
     def delete_priority_class(self, name: str) -> PriorityClass:
         return self.delete(PRIORITY_CLASSES, name)
+
+    def create_persistent_volume(self, pv: PersistentVolume) -> PersistentVolume:
+        return self.create(PVS, pv)
+
+    def update_persistent_volume(self, pv: PersistentVolume) -> PersistentVolume:
+        return self.update(PVS, pv)
+
+    def delete_persistent_volume(self, name: str) -> PersistentVolume:
+        return self.delete(PVS, name)
+
+    def create_persistent_volume_claim(
+        self, pvc: PersistentVolumeClaim
+    ) -> PersistentVolumeClaim:
+        return self.create(PVCS, pvc)
+
+    def update_persistent_volume_claim(
+        self, pvc: PersistentVolumeClaim
+    ) -> PersistentVolumeClaim:
+        return self.update(PVCS, pvc)
+
+    def delete_persistent_volume_claim(
+        self, namespace: str, name: str
+    ) -> PersistentVolumeClaim:
+        return self.delete(PVCS, f"{namespace}/{name}")
+
+    def create_storage_class(self, sc: StorageClass) -> StorageClass:
+        return self.create(STORAGE_CLASSES, sc)

@@ -378,8 +378,18 @@ class Session:
                 self._dispatch(t)
 
     def _dispatch(self, task: TaskInfo) -> None:
-        """session.go:298-322."""
-        self.cache.bind_volumes(task)
+        """session.go:298-322. A failed volume bind routes the task
+        through the cache's errTasks resync queue (self-heal: the task
+        re-syncs to its store state and is rescheduled next cycle) and
+        propagates, leaving later gang members undispatched exactly like
+        the reference's early return."""
+        try:
+            self.cache.bind_volumes(task)
+        except Exception:
+            resync = getattr(self.cache, "resync_task", None)
+            if resync is not None:
+                resync(task)
+            raise
         self.cache.bind(task, task.node_name)
         job = self.jobs.get(task.job)
         if job is None:

@@ -281,14 +281,25 @@ def encode_session(
                 # host-side against the live session
                 host_only.append(t)
                 host_only_rows.append(len(task_list))
+            elif getattr(t.pod, "volumes", None):
+                # claims need the volume binder's assume step (PV
+                # topology, capacity, class matching) against live PVC/PV
+                # state — serial-stepped host-side like the reference's
+                # AssumePodVolumes inside ssn.Allocate (session.go:241-260)
+                host_only.append(t)
+                host_only_rows.append(len(task_list))
             task_list.append(t)
         job_ranges.append((start, len(task_list)))
 
     # InterPodAffinity activation: any pod-affinity terms anywhere (pending
     # or resident) make nodeorder's interpod score nonzero-able; the score
     # is per *node* (it reads each node's residents), so it rides its own
-    # [GT, N] matrix rather than the node-group-level aff_sc.
-    interpod_active = bool(host_only) or any(
+    # [GT, N] matrix rather than the node-group-level aff_sc. Volume-only
+    # host_only tasks do NOT activate it — claims change no scores.
+    interpod_active = any(
+        t.pod.affinity is not None and t.pod.affinity.has_pod_affinity_terms()
+        for t in host_only
+    ) or any(
         rt.pod.affinity is not None and rt.pod.affinity.has_pod_affinity_terms()
         for n in node_list
         for rt in n.tasks.values()

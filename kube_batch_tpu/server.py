@@ -15,7 +15,8 @@ exposes:
   ``DELETE /apis/v1alpha1/queues/<name>`` — the queue CRD surface the
   reference CLI talks to (pkg/cli/queue);
 - ``GET|POST /apis/v1alpha1/pods`` / ``nodes`` / ``podgroups`` /
-  ``priorityclasses`` / ``poddisruptionbudgets`` and the matching
+  ``priorityclasses`` / ``poddisruptionbudgets`` / ``persistentvolumes`` /
+  ``persistentvolumeclaims`` / ``storageclasses`` and the matching
   ``DELETE`` routes — the workload-ingestion surface an external control
   plane uses to feed the in-process cluster (the list/watch half the
   reference gets from the Kubernetes API server; here creations fan out
@@ -170,6 +171,41 @@ def _make_handler(server: "SchedulerServer"):
                     for b in server.store.list("poddisruptionbudgets")
                 ]
                 self._reply(200, json.dumps({"items": pdbs}))
+            elif self.path == "/apis/v1alpha1/persistentvolumes":
+                pvs = [
+                    {
+                        "name": v.name,
+                        "capacity": v.capacity_storage,
+                        "storage_class": v.storage_class_name,
+                        "phase": v.phase.value,
+                        "claim_ref": v.claim_ref,
+                    }
+                    for v in server.store.list("persistentvolumes")
+                ]
+                self._reply(200, json.dumps({"items": pvs}))
+            elif self.path == "/apis/v1alpha1/persistentvolumeclaims":
+                pvcs = [
+                    {
+                        "namespace": c.namespace,
+                        "name": c.name,
+                        "storage_class": c.storage_class_name,
+                        "request": c.request_storage,
+                        "phase": c.phase.value,
+                        "volume_name": c.volume_name,
+                    }
+                    for c in server.store.list("persistentvolumeclaims")
+                ]
+                self._reply(200, json.dumps({"items": pvcs}))
+            elif self.path == "/apis/v1alpha1/storageclasses":
+                scs = [
+                    {
+                        "name": s.name,
+                        "provisioner": s.provisioner,
+                        "volume_binding_mode": s.volume_binding_mode.value,
+                    }
+                    for s in server.store.list("storageclasses")
+                ]
+                self._reply(200, json.dumps({"items": scs}))
             else:
                 self._reply(404, json.dumps({"error": "not found"}))
 
@@ -250,6 +286,9 @@ def _make_handler(server: "SchedulerServer"):
                         scheduler_name=field(
                             body, "scheduler_name", str, server.cache.scheduler_name
                         ),
+                        volumes=[
+                            str(v) for v in field(body, "volumes", list, []) or []
+                        ],
                     )
                     pod.priority_class_name = field(body, "priority_class_name", str, "")
                     # Admission-controller stand-in: kube-batch reads
@@ -330,6 +369,77 @@ def _make_handler(server: "SchedulerServer"):
                     )
                     server.store.create_pdb(pdb)
                     self._reply(201, json.dumps({"namespace": namespace, "name": name}))
+                elif self.path == "/apis/v1alpha1/persistentvolumes":
+                    from kube_batch_tpu.apis.types import (
+                        NodeSelectorTerm,
+                        PersistentVolume,
+                    )
+                    from kube_batch_tpu.testing import parse_quantity
+
+                    name = field(body, "name", str, None, required=True)
+                    terms = []
+                    for t in field(body, "node_affinity", list, []) or []:
+                        if not isinstance(t, dict):
+                            raise ValueError("node_affinity entries must be objects")
+                        terms.append(
+                            NodeSelectorTerm(
+                                key=str(t.get("key", "")),
+                                operator=str(t.get("operator", "In")),
+                                values=[str(v) for v in t.get("values", [])],
+                            )
+                        )
+                    from kube_batch_tpu.apis.types import VolumePhase
+
+                    pv = PersistentVolume(
+                        metadata=ObjectMeta(name=name, uid=f"pv-{name}"),
+                        capacity_storage=parse_quantity(body.get("capacity", 0)),
+                        storage_class_name=field(body, "storage_class", str, ""),
+                        node_affinity=terms,
+                        # Mirroring an existing cluster needs bound state
+                        # expressible at ingestion time.
+                        claim_ref=field(body, "claim_ref", str, ""),
+                        phase=VolumePhase(field(body, "phase", str, "Available")),
+                    )
+                    server.store.create_persistent_volume(pv)
+                    self._reply(201, json.dumps({"name": name}))
+                elif self.path == "/apis/v1alpha1/persistentvolumeclaims":
+                    from kube_batch_tpu.apis.types import PersistentVolumeClaim
+                    from kube_batch_tpu.testing import parse_quantity
+
+                    name = field(body, "name", str, None, required=True)
+                    namespace = field(body, "namespace", str, "default")
+                    from kube_batch_tpu.apis.types import VolumePhase
+
+                    volume_name = field(body, "volume_name", str, "")
+                    pvc = PersistentVolumeClaim(
+                        metadata=ObjectMeta(
+                            name=name, namespace=namespace, uid=f"pvc-{namespace}-{name}"
+                        ),
+                        storage_class_name=field(body, "storage_class", str, ""),
+                        request_storage=parse_quantity(body.get("request", 0)),
+                        volume_name=volume_name,
+                        phase=VolumePhase(
+                            field(body, "phase", str, "Bound" if volume_name else "Pending")
+                        ),
+                    )
+                    server.store.create_persistent_volume_claim(pvc)
+                    self._reply(201, json.dumps({"namespace": namespace, "name": name}))
+                elif self.path == "/apis/v1alpha1/storageclasses":
+                    from kube_batch_tpu.apis.types import (
+                        StorageClass,
+                        VolumeBindingMode,
+                    )
+
+                    name = field(body, "name", str, None, required=True)
+                    sc = StorageClass(
+                        metadata=ObjectMeta(name=name, uid=f"sc-{name}"),
+                        provisioner=field(body, "provisioner", str, ""),
+                        volume_binding_mode=VolumeBindingMode(
+                            field(body, "volume_binding_mode", str, "Immediate")
+                        ),
+                    )
+                    server.store.create_storage_class(sc)
+                    self._reply(201, json.dumps({"name": name}))
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
             except AlreadyExists as e:
@@ -356,6 +466,12 @@ def _make_handler(server: "SchedulerServer"):
                     server.store.delete_priority_class(rest[0])
                 elif kind == "poddisruptionbudgets" and len(rest) == 2:
                     server.store.delete("poddisruptionbudgets", f"{rest[0]}/{rest[1]}")
+                elif kind == "persistentvolumes" and len(rest) == 1:
+                    server.store.delete_persistent_volume(rest[0])
+                elif kind == "persistentvolumeclaims" and len(rest) == 2:
+                    server.store.delete_persistent_volume_claim(rest[0], rest[1])
+                elif kind == "storageclasses" and len(rest) == 1:
+                    server.store.delete("storageclasses", rest[0])
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
                     return

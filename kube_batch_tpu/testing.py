@@ -91,6 +91,7 @@ def build_pod(
     priority: Optional[int] = None,
     node_selector: Optional[dict[str, str]] = None,
     scheduler_name: str = "kube-batch-tpu",
+    volumes: Optional[list[str]] = None,
 ) -> Pod:
     """reference api/test_utils.go buildPod."""
     annotations = {}
@@ -110,6 +111,7 @@ def build_pod(
         node_selector=node_selector or {},
         priority=priority,
         scheduler_name=scheduler_name,
+        volumes=list(volumes or []),
     )
 
 
@@ -143,6 +145,46 @@ def build_pod_group(
 
 def build_queue(name: str, weight: int = 1) -> Queue:
     return Queue(metadata=ObjectMeta(name=name, uid=f"q-{name}"), spec=QueueSpec(weight=weight))
+
+
+def build_pv(
+    name: str,
+    capacity: Union[str, int, float] = "10Gi",
+    storage_class: str = "",
+    node_affinity: Optional[list] = None,
+):
+    from kube_batch_tpu.apis.types import PersistentVolume
+
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, uid=f"pv-{name}"),
+        capacity_storage=parse_quantity(capacity),
+        storage_class_name=storage_class,
+        node_affinity=list(node_affinity or []),
+    )
+
+
+def build_pvc(
+    name: str,
+    namespace: str = "default",
+    storage_class: str = "",
+    request: Union[str, int, float] = "1Gi",
+):
+    from kube_batch_tpu.apis.types import PersistentVolumeClaim
+
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=f"pvc-{namespace}-{name}"),
+        storage_class_name=storage_class,
+        request_storage=parse_quantity(request),
+    )
+
+
+def build_storage_class(name: str, mode: str = "Immediate"):
+    from kube_batch_tpu.apis.types import StorageClass, VolumeBindingMode
+
+    return StorageClass(
+        metadata=ObjectMeta(name=name, uid=f"sc-{name}"),
+        volume_binding_mode=VolumeBindingMode(mode),
+    )
 
 
 def build_task(

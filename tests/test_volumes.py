@@ -1,0 +1,326 @@
+"""Volume lifecycle: assume-at-allocate / bind-at-dispatch over the
+in-process store (reference cache.go:165-189 volumebinder wiring,
+interface.go:46-56 contract, session.go:241-260 assume and :298-322
+bind; PV/PVC/StorageClass informers cache.go:268-297)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kube_batch_tpu import actions  # noqa: F401  (registers actions)
+from kube_batch_tpu import plugins  # noqa: F401  (registers plugins)
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.apis.types import NodeSelectorTerm, VolumePhase
+from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.cache.cache import StoreVolumeBinder, VolumeBindingError
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_pv,
+    build_pvc,
+    build_queue,
+    build_resource_list,
+    build_storage_class,
+)
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.apis.types import PodGroupPhase
+
+
+def inqueue(pg):
+    # allocate skips Pending-phase PodGroups (the enqueue action's gate);
+    # these tests drive allocate directly
+    pg.status.phase = PodGroupPhase.INQUEUE
+    return pg
+
+TIERS = parse_scheduler_conf(
+    """
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+).tiers
+
+
+def wait_until(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def store():
+    s = ClusterStore()
+    s.create_queue(build_queue("default"))
+    return s
+
+
+def make_cache(store):
+    return SchedulerCache(store)
+
+
+# -- binder unit behavior ---------------------------------------------------
+
+
+def test_assume_picks_smallest_fitting_pv(store):
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("n1", build_resource_list(cpu=4)))
+    store.create_storage_class(build_storage_class("fast"))
+    store.create_persistent_volume(build_pv("big", capacity="100Gi", storage_class="fast"))
+    store.create_persistent_volume(build_pv("small", capacity="2Gi", storage_class="fast"))
+    store.create_persistent_volume(build_pv("wrong-class", capacity="2Gi", storage_class="slow"))
+    store.create_persistent_volume_claim(build_pvc("c1", storage_class="fast", request="1Gi"))
+
+    pod = build_pod(name="p1", req=build_resource_list(cpu=1), volumes=["c1"])
+    task = TaskInfo(pod)
+    binder.allocate_volumes(task, "n1")
+    assert binder._assumed[task.uid] == {"default/c1": "small"}
+    assert task.volume_ready is False
+
+    binder.bind_volumes(task)
+    assert task.volume_ready is True
+    assert store.get("persistentvolumes", "small").claim_ref == "default/c1"
+    assert store.get("persistentvolumes", "small").phase == VolumePhase.BOUND
+    pvc = store.get("persistentvolumeclaims", "default/c1")
+    assert pvc.volume_name == "small" and pvc.phase == VolumePhase.BOUND
+
+
+def test_assume_respects_pv_topology_and_reservation(store):
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("na", labels={"zone": "a"}))
+    store.create_node(build_node("nb", labels={"zone": "b"}))
+    store.create_persistent_volume(
+        build_pv("pv-a", node_affinity=[NodeSelectorTerm(key="zone", values=["a"])])
+    )
+    store.create_persistent_volume_claim(build_pvc("c1", request="1Gi"))
+    store.create_persistent_volume_claim(build_pvc("c2", request="1Gi"))
+
+    t1 = TaskInfo(build_pod(name="p1", volumes=["c1"]))
+    with pytest.raises(VolumeBindingError):
+        binder.allocate_volumes(t1, "nb")  # topology mismatch
+    binder.allocate_volumes(t1, "na")
+
+    # pv-a is reserved for c1 now; c2 cannot take it
+    t2 = TaskInfo(build_pod(name="p2", volumes=["c2"]))
+    with pytest.raises(VolumeBindingError):
+        binder.allocate_volumes(t2, "na")
+    binder.forget(t1.uid)
+    binder.allocate_volumes(t2, "na")  # freed by rollback
+
+
+def test_unknown_claim_fails_assume(store):
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("n1"))
+    t = TaskInfo(build_pod(name="p1", volumes=["nope"]))
+    with pytest.raises(VolumeBindingError):
+        binder.allocate_volumes(t, "n1")
+
+
+# -- through the live cache + serial action ---------------------------------
+
+
+def run_allocate(store, action_name="allocate"):
+    cache = make_cache(store)
+    ssn = open_session(cache, TIERS)
+    get_action(action_name).execute(ssn)
+    state = {
+        t.uid: (t.status, t.node_name)
+        for j in ssn.jobs.values()
+        for d in j.task_status_index.values()
+        for t in d.values()
+    }
+    close_session(ssn)
+    cache.stop()
+    return state
+
+
+@pytest.mark.parametrize("action_name", ["allocate", "xla_allocate"])
+def test_gang_with_volumes_binds_atomically(store, action_name):
+    """A 2-member gang whose pods claim zone-pinned volumes lands each pod
+    on the zone its volume lives in, binds atomically, and flips both
+    PVCs to Bound (assume at allocate, bind at the gang dispatch)."""
+    store.create_node(
+        build_node("na", build_resource_list(cpu=4, memory="8Gi", pods=10), labels={"zone": "a"})
+    )
+    store.create_node(
+        build_node("nb", build_resource_list(cpu=4, memory="8Gi", pods=10), labels={"zone": "b"})
+    )
+    store.create_persistent_volume(
+        build_pv("pv-a", node_affinity=[NodeSelectorTerm(key="zone", values=["a"])])
+    )
+    store.create_persistent_volume(
+        build_pv("pv-b", node_affinity=[NodeSelectorTerm(key="zone", values=["b"])])
+    )
+    store.create_persistent_volume_claim(build_pvc("ca", request="1Gi"))
+    store.create_persistent_volume_claim(build_pvc("cb", request="1Gi"))
+    store.create_pod_group(inqueue(build_pod_group("pg", min_member=2)))
+    store.create_pod(
+        build_pod(name="pa", group_name="pg", req=build_resource_list(cpu=1, memory="1Gi"),
+                  node_selector={"zone": "a"}, volumes=["ca"])
+    )
+    store.create_pod(
+        build_pod(name="pb", group_name="pg", req=build_resource_list(cpu=1, memory="1Gi"),
+                  node_selector={"zone": "b"}, volumes=["cb"])
+    )
+
+    state = run_allocate(store, action_name)
+    # The session sees BINDING; the Bound flip arrives in the *cache* via
+    # the store's watch echo (bind round-trip), so assert the durable
+    # store state for the rest.
+    assert state["default-pa"][0] in (TaskStatus.BINDING, TaskStatus.BOUND)
+    assert state["default-pa"][1] == "na"
+    assert state["default-pb"][1] == "nb"
+    assert store.get("persistentvolumeclaims", "default/ca").volume_name == "pv-a"
+    assert store.get("persistentvolumeclaims", "default/cb").volume_name == "pv-b"
+    assert store.get("persistentvolumes", "pv-a").phase == VolumePhase.BOUND
+    assert store.get_pod("default", "pa").node_name == "na"
+
+
+@pytest.mark.parametrize("action_name", ["allocate", "xla_allocate"])
+def test_unsatisfiable_claim_leaves_task_pending(store, action_name):
+    """WaitForFirstConsumer with no pre-provisioned PV: the assume fails,
+    the task stays Pending, and the cycle (and the other job) survives."""
+    store.create_storage_class(build_storage_class("wffc", mode="WaitForFirstConsumer"))
+    store.create_node(build_node("n1", build_resource_list(cpu=4, memory="8Gi", pods=10)))
+    store.create_persistent_volume_claim(build_pvc("c1", storage_class="wffc"))
+    store.create_pod_group(inqueue(build_pod_group("pg-vol", min_member=1)))
+    store.create_pod(
+        build_pod(name="vol-pod", group_name="pg-vol",
+                  req=build_resource_list(cpu=1, memory="1Gi"), volumes=["c1"])
+    )
+    store.create_pod_group(inqueue(build_pod_group("pg-plain", min_member=1)))
+    store.create_pod(
+        build_pod(name="plain-pod", group_name="pg-plain",
+                  req=build_resource_list(cpu=1, memory="1Gi"))
+    )
+
+    state = run_allocate(store, action_name)
+    assert state["default-vol-pod"] == (TaskStatus.PENDING, "")
+    assert state["default-plain-pod"][0] in (TaskStatus.BINDING, TaskStatus.BOUND)
+    assert state["default-plain-pod"][1] == "n1"
+    assert store.get_pod("default", "vol-pod").node_name == ""
+    assert store.get_pod("default", "plain-pod").node_name == "n1"
+
+
+def test_failed_volume_bind_resyncs_task(store):
+    """An assumed PV that vanishes before dispatch: bind_volumes raises,
+    the task routes through errTasks, and the resync returns it to
+    Pending (reference cache.go:512-534 self-heal)."""
+    cache = make_cache(store)
+    cache.run()
+    try:
+        store.create_node(build_node("n1", build_resource_list(cpu=4, memory="8Gi", pods=10)))
+        store.create_persistent_volume(build_pv("pv1"))
+        store.create_persistent_volume_claim(build_pvc("c1", request="1Gi"))
+        store.create_pod_group(inqueue(build_pod_group("pg", min_member=2)))
+        store.create_pod(
+            build_pod(name="p1", group_name="pg",
+                      req=build_resource_list(cpu=1, memory="1Gi"), volumes=["c1"])
+        )
+        store.create_pod(
+            build_pod(name="p2", group_name="pg", req=build_resource_list(cpu=1, memory="1Gi"))
+        )
+
+        ssn = open_session(cache, TIERS)
+        job = next(iter(ssn.jobs.values()))
+        t1 = next(t for t in job.tasks.values() if t.name == "p1")
+        t2 = next(t for t in job.tasks.values() if t.name == "p2")
+        ssn.allocate(t1, "n1")  # assumes pv1
+        store.delete_persistent_volume("pv1")  # yanked before dispatch
+        with pytest.raises(VolumeBindingError):
+            ssn.allocate(t2, "n1")  # gang ready -> dispatch -> bind fails
+        close_session(ssn)
+
+        wait_until(
+            lambda: next(
+                t.status
+                for j in cache.jobs.values()
+                for t in j.tasks.values()
+                if t.name == "p1"
+            )
+            == TaskStatus.PENDING,
+            what="errTasks resync back to Pending",
+        )
+    finally:
+        cache.stop()
+
+
+def test_two_claims_one_pod_distinct_pvs(store):
+    """Sibling claims of one pod must land on distinct PVs even when the
+    smallest PV matches both (round-4 review finding)."""
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("n1"))
+    store.create_persistent_volume(build_pv("small", capacity="2Gi"))
+    store.create_persistent_volume(build_pv("big", capacity="20Gi"))
+    store.create_persistent_volume_claim(build_pvc("c1", request="1Gi"))
+    store.create_persistent_volume_claim(build_pvc("c2", request="1Gi"))
+    t = TaskInfo(build_pod(name="p1", volumes=["c1", "c2"]))
+    binder.allocate_volumes(t, "n1")
+    assert sorted(binder._assumed[t.uid].values()) == ["big", "small"]
+    binder.bind_volumes(t)
+    assert store.get("persistentvolumeclaims", "default/c1").volume_name == "small"
+    assert store.get("persistentvolumeclaims", "default/c2").volume_name == "big"
+
+
+def test_failed_bind_keeps_assumptions_for_retry(store):
+    """A failed bind must not destroy the assumption record: the retry
+    re-attempts the real writes instead of vacuously succeeding."""
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("n1"))
+    store.create_persistent_volume(build_pv("pv1"))
+    store.create_persistent_volume_claim(build_pvc("c1", request="1Gi"))
+    t = TaskInfo(build_pod(name="p1", volumes=["c1"]))
+    binder.allocate_volumes(t, "n1")
+    store.delete_persistent_volume("pv1")
+    with pytest.raises(VolumeBindingError):
+        binder.bind_volumes(t)
+    assert binder._assumed[t.uid] == {"default/c1": "pv1"}  # record survives
+    with pytest.raises(VolumeBindingError):
+        binder.bind_volumes(t)  # still fails, does NOT bind pod sans volume
+    # PV restored (e.g. re-created by an operator): retry succeeds
+    store.create_persistent_volume(build_pv("pv1"))
+    binder.bind_volumes(t)
+    assert store.get("persistentvolumeclaims", "default/c1").volume_name == "pv1"
+
+
+def test_bound_claim_pins_pod_to_volume_topology(store):
+    """A claim already Bound (mirrored from an existing cluster) pins its
+    pod to nodes the PV tolerates — the assume's bound-claim branch."""
+    import dataclasses as dc
+
+    from kube_batch_tpu.apis.types import VolumePhase
+
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("na", labels={"zone": "a"}))
+    store.create_node(build_node("nb", labels={"zone": "b"}))
+    pv = build_pv("pv-a", node_affinity=[NodeSelectorTerm(key="zone", values=["a"])])
+    store.create_persistent_volume(dc.replace(pv, claim_ref="default/c1", phase=VolumePhase.BOUND))
+    pvc = build_pvc("c1", request="1Gi")
+    pvc.volume_name = "pv-a"
+    pvc.phase = VolumePhase.BOUND
+    store.create_persistent_volume_claim(pvc)
+    t = TaskInfo(build_pod(name="p1", volumes=["c1"]))
+    with pytest.raises(VolumeBindingError):
+        binder.allocate_volumes(t, "nb")
+    binder.allocate_volumes(t, "na")
+    assert t.volume_ready is True  # nothing left to bind
+
+
+def test_unknown_storage_class_fails_assume(store):
+    binder = StoreVolumeBinder(store)
+    store.create_node(build_node("n1"))
+    store.create_persistent_volume_claim(build_pvc("c1", storage_class="no-such-class"))
+    t = TaskInfo(build_pod(name="p1", volumes=["c1"]))
+    with pytest.raises(VolumeBindingError):
+        binder.allocate_volumes(t, "n1")
