@@ -386,7 +386,15 @@ class StoreVolumeBinder:
         """Drop every outstanding assumption. Called at snapshot time:
         assume/bind both happen synchronously within one session, so
         anything still assumed when a new session starts belongs to a
-        gang that never dispatched — its PVs must come back."""
+        gang that never dispatched — its PVs must come back.
+
+        Within a cycle, an unready gang's reservations deliberately
+        persist: the reference keeps an Allocated-but-not-ready gang's
+        *node* resources held for the rest of the cycle too (the task
+        stays Allocated on its NodeInfo until the session ends,
+        session.go:241-296) — volumes follow the same lifetime so a
+        later job cannot take a PV out from under a gang that might
+        still complete this cycle."""
         with self._lock:
             self._assumed.clear()
             self._reserved.clear()

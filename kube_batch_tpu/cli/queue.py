@@ -15,12 +15,14 @@ from kube_batch_tpu.version import info as version_info
 DEFAULT_SERVER = "http://127.0.0.1:8080"
 
 
-def _request(method: str, url: str, body: Optional[dict] = None) -> dict:
+def _request(
+    method: str, url: str, body: Optional[dict] = None, timeout: float = 10
+) -> dict:
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     if data is not None:
         req.add_header("Content-Type", "application/json")
-    with urllib.request.urlopen(req, timeout=10) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         payload = resp.read()
     return json.loads(payload) if payload else {}
 
@@ -44,11 +46,43 @@ def cmd_create(args, out: TextIO) -> int:
 def cmd_list(args, out: TextIO) -> int:
     payload = _request("GET", f"{args.server}/apis/v1alpha1/queues")
     items = payload.get("items", [])
-    if not items:
+    if not items and not getattr(args, "watch", False):
         out.write("No resources found\n")  # list.go:63-65
         return 0
     print_queues(items, out)
+    if getattr(args, "watch", False):
+        _watch_queues(args, payload.get("resourceVersion", 0), out)
     return 0
+
+
+def _watch_queues(args, since: int, out: TextIO) -> None:
+    """Long-poll /watch/queues from the list's resourceVersion, printing
+    one line per event until interrupted (kubectl get -w shape)."""
+    while True:
+        try:
+            payload = _request(
+                "GET",
+                f"{args.server}/apis/v1alpha1/watch/queues"
+                f"?since={since}&timeout={args.watch_timeout}",
+                timeout=args.watch_timeout + 10,
+            )
+        except urllib.error.HTTPError as err:
+            if err.code == 410:  # fell behind the ring: re-list and resume
+                listing = _request("GET", f"{args.server}/apis/v1alpha1/queues")
+                print_queues(listing.get("items", []), out)
+                since = listing.get("resourceVersion", 0)
+                continue
+            raise
+        for ev in payload.get("events", []):
+            q = ev.get("object", {})
+            out.write(
+                f"{ev.get('type', ''):<10}{q.get('name', ''):<25}"
+                f"{q.get('weight', 0):<8}\n"
+            )
+            out.flush()
+        since = payload.get("resourceVersion", since)
+        if getattr(args, "watch_once", False) and payload.get("events"):
+            return
 
 
 def cmd_delete(args, out: TextIO) -> int:
@@ -80,6 +114,16 @@ def build_parser() -> argparse.ArgumentParser:
     create.set_defaults(fn=cmd_create)
 
     lst = qsub.add_parser("list", help="list queues (list.go:54-70)")
+    lst.add_argument(
+        "--watch", action="store_true",
+        help="after listing, stream queue add/update/delete events",
+    )
+    lst.add_argument(
+        "--watch-timeout", type=float, default=30.0, help=argparse.SUPPRESS
+    )
+    lst.add_argument(
+        "--watch-once", action="store_true", help=argparse.SUPPRESS
+    )  # exit after the first event batch (tests)
     lst.set_defaults(fn=cmd_list)
 
     delete = qsub.add_parser("delete", help="delete a queue")
