@@ -154,9 +154,7 @@ class XlaAllocateAction(Action):
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
         mesh = self._resolve_mesh(ssn)
-        solve_fn = self._make_solver(
-            arrays, enable_drf, enable_proportion, dtype, enc.interpod_active, mesh
-        )
+        solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype, mesh)
 
         t0 = _time.perf_counter()
         state = solve_fn(None)
@@ -266,7 +264,6 @@ class XlaAllocateAction(Action):
         enable_drf: bool,
         enable_proportion: bool,
         dtype,
-        interpod_active: bool = False,
         mesh=None,
     ):
         """Pick the device solve: with a conf-selected multi-chip mesh,
@@ -275,9 +272,10 @@ class XlaAllocateAction(Action):
         (float32, in-envelope snapshots), else the XLA `lax.while_loop`
         kernel. `KBT_PALLAS=0` forces the XLA kernel; `KBT_PALLAS=interpret`
         runs the Pallas kernel in interpreter mode (CPU parity tests).
-        Snapshots with live InterPodAffinity scores use the XLA kernel —
-        its pod_sc input refreshes between resumes, while the Pallas
-        solver packs statics once."""
+        Live InterPodAffinity scores no longer force the XLA kernel: the
+        Pallas solver re-folds its affinity static whenever the action
+        refreshes arrays["pod_sc"] between pause/resume segments
+        (pallas_solve.fold_affinity_scores)."""
         from kube_batch_tpu.ops.kernels import solve_allocate_state
 
         if mesh is not None:
@@ -323,7 +321,7 @@ class XlaAllocateAction(Action):
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None
-        if mode != "0" and dtype == np.float32 and not interpod_active:
+        if mode != "0" and dtype == np.float32:
             import jax as _jax
 
             from kube_batch_tpu.ops import pallas_solve
@@ -657,42 +655,70 @@ class _Replayer:
                 self._touched_prop.add(qname)
 
         # -- per-task surgery (status index, node task map, volumes) ------
+        # Rows grouped per job (stable sort preserves assign order within
+        # a job, which is what fixes sidx insertion order and therefore
+        # dispatch/bind order); the status-index moves then land as one
+        # C-level dict.update per (job, status) instead of per-task
+        # get/setdefault (VERDICT r3 item 8, the replay diet).
         tasks = self.enc.tasks
         tkeys = self.task_keys
         node_by_row = self.node_by_row
         jobs_l = self.enc.jobs
         alloc_volumes = self.ssn.cache.allocate_volumes
         ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
-        cur_jrow = -1
-        sidx = pend = None
-        for row, nrow, jrow, is_alloc in zip(
-            rows.tolist(), nrows.tolist(), tjob.tolist(), alloc.tolist()
-        ):
-            task = tasks[row]
-            hostname = node_by_row[nrow].name
-            if jrow != cur_jrow:
-                cur_jrow = jrow
-                sidx = jobs_l[jrow].task_status_index
-                pend = sidx.get(TaskStatus.PENDING)
-            if is_alloc:
-                alloc_volumes(task, hostname)
-                status = ALLOCATED
-            else:
-                status = PIPELINED
-            if pend is not None:
-                pend.pop(task.uid, None)
-            task.status = status
-            task.node_name = hostname
-            d = sidx.get(status)
-            if d is None:
-                d = sidx[status] = {}
-            d[task.uid] = task
-            node_by_row[nrow].tasks[tkeys[row]] = task.clone_for_residency()
-        for jrow in touched_j.tolist():
+        order = np.argsort(compj, kind="stable")
+        counts = np.bincount(compj, minlength=touched_j.size).tolist()
+        rows_o = rows[order].tolist()
+        nrows_o = nrows[order].tolist()
+        alloc_o = alloc[order].tolist()
+        pos = 0
+        for k, jrow in enumerate(touched_j.tolist()):
+            cnt = counts[k]
+            end = pos + cnt
             sidx = jobs_l[jrow].task_status_index
             pend = sidx.get(TaskStatus.PENDING)
-            if pend is not None and not pend:
-                del sidx[TaskStatus.PENDING]
+            alloc_d: dict = {}
+            pipe_d: dict = {}
+            for row, nrow_i, is_alloc in zip(
+                rows_o[pos:end], nrows_o[pos:end], alloc_o[pos:end]
+            ):
+                task = tasks[row]
+                node = node_by_row[nrow_i]
+                if is_alloc:
+                    if task.pod.volumes:
+                        # bulk rows cannot carry claims (encode routes
+                        # volume pods host_only) — guard kept for custom
+                        # encoders/binders
+                        alloc_volumes(task, node.name)
+                    else:
+                        task.volume_ready = True
+                    task.status = ALLOCATED
+                    alloc_d[task.uid] = task
+                else:
+                    task.status = PIPELINED
+                    pipe_d[task.uid] = task
+                task.node_name = node.name
+                node.tasks[tkeys[row]] = task.clone_for_residency()
+            pos = end
+            if pend is not None:
+                for uid in alloc_d:
+                    pend.pop(uid, None)
+                for uid in pipe_d:
+                    pend.pop(uid, None)
+                if not pend:
+                    del sidx[TaskStatus.PENDING]
+            if alloc_d:
+                d = sidx.get(ALLOCATED)
+                if d is None:
+                    sidx[ALLOCATED] = alloc_d
+                else:
+                    d.update(alloc_d)
+            if pipe_d:
+                d = sidx.get(PIPELINED)
+                if d is None:
+                    sidx[PIPELINED] = pipe_d
+                else:
+                    d.update(pipe_d)
 
     def _flush_nodes(self) -> None:
         """Fold the per-node resource deltas into NodeInfo, following
@@ -727,8 +753,8 @@ class _Replayer:
         now = _time.time()
         job_min = self.arrays["job_min"]
         bind_volumes = ssn.cache.bind_volumes
-        bind = ssn.cache.bind
         durations: list[float] = []
+        to_bind: list = []  # dispatched tasks, in dispatch order
         for i, job in enumerate(self.enc.jobs):
             if job.uid not in self.alloc_jobs:
                 continue
@@ -737,29 +763,49 @@ class _Replayer:
             allocated = job.task_status_index.get(TaskStatus.ALLOCATED)
             if not allocated:
                 continue
-            binding = job.task_status_index.setdefault(TaskStatus.BINDING, {})
-            for task in list(allocated.values()):
-                try:
-                    bind_volumes(task)
-                except Exception as e:  # noqa: BLE001
-                    # Same routing as session._dispatch: errTasks resync +
-                    # stop dispatching this gang (the serial path's early
-                    # return from the JobReady loop, session.go:285-295).
-                    log.error(
-                        "failed to bind volumes of %s: %s", task.uid, e
-                    )
-                    resync = getattr(ssn.cache, "resync_task", None)
-                    if resync is not None:
-                        resync(task)
-                    break
-                bind(task, task.node_name)
-                allocated.pop(task.uid, None)
+            dispatched = []
+            failed = False
+            for task in allocated.values():
+                if task.pod.volumes or not task.volume_ready:
+                    try:
+                        bind_volumes(task)
+                    except Exception as e:  # noqa: BLE001
+                        # Same routing as session._dispatch: errTasks
+                        # resync + stop dispatching this gang (the serial
+                        # path's early return, session.go:285-295).
+                        log.error("failed to bind volumes of %s: %s", task.uid, e)
+                        resync = getattr(ssn.cache, "resync_task", None)
+                        if resync is not None:
+                            resync(task)
+                        failed = True
+                        break
                 task.status = TaskStatus.BINDING
-                binding[task.uid] = task
+                dispatched.append(task)
+                to_bind.append(task)
                 durations.append(max(0.0, now - task.pod.metadata.creation_timestamp))
-            if not allocated:
+            # status-index move as one bulk update instead of per-task
+            # pop/insert; on a volume failure only the dispatched prefix
+            # moves (the rest stay Allocated, exactly like the serial
+            # early return).
+            binding = job.task_status_index.setdefault(TaskStatus.BINDING, {})
+            if not failed:
+                binding.update(allocated)
                 job.task_status_index.pop(TaskStatus.ALLOCATED, None)
+            else:
+                for task in dispatched:
+                    allocated.pop(task.uid, None)
+                    binding[task.uid] = task
             log.debug("dispatched gang job %s (%d tasks)", job.uid, int(ready_cnt[i]))
+        # Bulk bind: one cache mutex acquisition + one async write batch
+        # for the whole action's dispatches (the replay-diet half of
+        # VERDICT r3 item 8 — per-task cache.bind was the replay's
+        # single largest cost at 50k).
+        bind_many = getattr(ssn.cache, "bind_many", None)
+        if bind_many is not None:
+            bind_many([(t, t.node_name) for t in to_bind])
+        else:
+            for t in to_bind:
+                ssn.cache.bind(t, t.node_name)
         metrics.update_task_schedule_durations(durations)
 
 

@@ -833,6 +833,41 @@ class SchedulerCache:
             pod = task.pod
         self._submit_write(self._do_bind, pod, hostname, task)
 
+    def bind_many(self, pairs: list) -> None:
+        """Bulk bind for the replay path: the per-bind net effect of
+        `bind()` under ONE mutex acquisition and ONE async write
+        submission (the reference fires a goroutine per pod,
+        cache.go:439-445; a vectorized action produces 50k binds in one
+        call, so the write side batches to match). `pairs` is
+        [(TaskInfo, hostname)]; a pair whose job/task/host vanished from
+        the mirror (concurrent delete events run under this same mutex)
+        routes through errTasks instead of aborting the batch, and
+        per-pod write failures still resync individually."""
+        resolved = []
+        failed = []
+        with self._mutex:
+            for ti, hostname in pairs:
+                try:
+                    job, task = self._find_job_and_task(ti)
+                    node = self.nodes.get(hostname)
+                    if node is None:
+                        raise KeyError(f"host {hostname} missing")
+                except KeyError as e:
+                    log.errorf("Failed to bind task %s: %s", ti.uid, e)
+                    failed.append(ti)
+                    continue
+                job.update_task_status(task, TaskStatus.BINDING)
+                task.node_name = hostname
+                node.add_task(task)
+                resolved.append((task.pod, hostname, task))
+        for ti in failed:
+            self.resync_task(ti)
+        self._submit_write(self._do_bind_many, resolved)
+
+    def _do_bind_many(self, resolved: list) -> None:
+        for pod, hostname, task in resolved:
+            self._do_bind(pod, hostname, task)
+
     def _do_bind(self, pod: Pod, hostname: str, task: TaskInfo) -> None:
         try:
             self.binder.bind(pod, hostname)

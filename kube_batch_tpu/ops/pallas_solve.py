@@ -206,6 +206,25 @@ def supported(a: dict) -> bool:
     return vmem <= VMEM_BUDGET
 
 
+def fold_affinity_scores(a: dict, Nr: int) -> np.ndarray:
+    """[GT, Nr, 128] combined static score term: preferred node-affinity
+    plus live InterPodAffinity, each pre-weighted (the kernel multiplies
+    by 1). Re-folded by PallasSolver.solve when the action refreshes
+    a["pod_sc"] between pause/resume segments — a [GT, N] multiply-add,
+    not a re-pack."""
+    f32 = np.float32
+    node_gid = np.asarray(a["node_gid"], np.int64)
+    N = node_gid.shape[0]
+    full = np.asarray(a["aff_sc"], f32)[:, node_gid] * f32(a["w_aff"])
+    pod_sc = np.asarray(a.get("pod_sc"), f32)
+    if pod_sc.ndim == 2 and pod_sc.any():
+        full = full + pod_sc * f32(a["w_podaff"])
+    GT = full.shape[0]
+    affw = np.zeros((GT, Nr, LANES), f32)
+    affw[:, : (N + LANES - 1) // LANES, :].reshape(GT, -1)[:, :N] = full
+    return affw
+
+
 def pack(a: dict, enable_drf: bool, enable_proportion: bool) -> _Packed:
     """Fold the encoder's SoA snapshot into the kernel's VMEM layout."""
     f32, i32 = np.float32, np.int32
@@ -233,12 +252,10 @@ def pack(a: dict, enable_drf: bool, enable_proportion: bool) -> _Packed:
     node_gid = np.asarray(a["node_gid"], np.int64)
     okv = np.asarray(a["node_ok"] & a["node_valid"])
     cnode_full = np.asarray(a["compat"])[:, node_gid] & okv[None, :]  # [GT,N]
-    affw_full = np.asarray(a["aff_sc"], f32)[:, node_gid]
     GT = cnode_full.shape[0]
     cnode = np.zeros((GT, Nr, LANES), i32)
-    affw = np.zeros((GT, Nr, LANES), f32)
     cnode[:, : (N + LANES - 1) // LANES, :].reshape(GT, -1)[:, :N] = cnode_full
-    affw[:, : (N + LANES - 1) // LANES, :].reshape(GT, -1)[:, :N] = affw_full
+    affw = fold_affinity_scores(a, Nr)
 
     nalloc = _fold2(np.asarray(a["node_alloc"], f32), Nr, f32)
     nmax = _fold1(np.asarray(a["node_max_tasks"], i32), Nr, i32)
@@ -263,7 +280,11 @@ def pack(a: dict, enable_drf: bool, enable_proportion: bool) -> _Packed:
     fscal[:R8] = eps
     fscal[8] = np.float32(a["w_least"])
     fscal[9] = np.float32(a["w_balanced"])
-    fscal[10] = np.float32(a["w_aff"])
+    # The affinity weights (w_aff AND w_podaff) are baked into the affw
+    # matrix at fold time (fold_affinity_scores), so the kernel's single
+    # multiplier is 1 — this is what lets live InterPodAffinity scores
+    # refresh between pause/resume segments without a kernel change.
+    fscal[10] = np.float32(1.0)
     drft = np.zeros(R8, f32)
     drfd = np.zeros(R8, i32)
     if enable_drf:
@@ -792,14 +813,23 @@ class PallasSolver:
         self.enable_proportion = enable_proportion
         self._fetch_f32 = fetch_f32  # tests compare idle/used; replay doesn't
         self.packed = pack(a, enable_drf, enable_proportion)
+        self._pod_sc = a.get("pod_sc")  # identity marker for refresh
         Tr, Nr, Jr, Qr, Cr, GT, R, self.max_iter = self.packed.dims
         self.fn = _build(
             Tr, Nr, Jr, Qr, Cr, GT, R, enable_drf, enable_proportion, interpret
         )
 
+    _AFFW_IDX = 9  # affw's position in _Packed.statics
+
     def solve(self, state: SolveState | None = None) -> SolveState:
         p = self.packed
         Tr, Nr, Jr, Qr, Cr, GT, R, max_iter = p.dims
+        if self.a.get("pod_sc") is not self._pod_sc:
+            # The action recomputed live InterPodAffinity scores after a
+            # host-stepped pod landed (VERDICT r3 item 7): re-fold just
+            # the affinity static and resume with the fresh scores.
+            self._pod_sc = self.a.get("pod_sc")
+            p.statics[self._AFFW_IDX] = fold_affinity_scores(self.a, Nr)
         f32, i32 = np.float32, np.int32
         if state is None:
             state = _initial_state(self.a, self.enable_drf, self.enable_proportion)

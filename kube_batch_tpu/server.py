@@ -141,6 +141,14 @@ class WatchHub:
         # a quiet kind is not forced to re-list because pods churned.
         self._dropped: dict[str, int] = {}
         self._closed = False
+        # The journal is lazy: until the first list/watch consumer reads
+        # a resourceVersion, events only bump the counter — no body
+        # serialization, ring append, or notify on the store's hot
+        # mutation path. `_journal_start` is the seq at activation;
+        # a `since` before it is Gone (nothing earlier was journaled,
+        # and no client can legitimately hold such an rv).
+        self._active = False
+        self._journal_start = 0
         for kind in KINDS:
             store.add_event_handler(
                 kind,
@@ -152,6 +160,13 @@ class WatchHub:
             )
 
     def _emit(self, kind: str, verb: str, obj) -> None:
+        if not self._active:
+            # Double-checked under the lock; pre-activation events only
+            # bump the counter (nobody is owed them).
+            with self._cond:
+                if not self._active:
+                    self._seq += 1
+                    return
         body = SERIALIZERS[kind](obj)
         with self._cond:
             self._seq += 1
@@ -167,9 +182,15 @@ class WatchHub:
             self._closed = True
             self._cond.notify_all()
 
+    def _activate_locked(self) -> None:
+        if not self._active:
+            self._active = True
+            self._journal_start = self._seq
+
     @property
     def resource_version(self) -> int:
         with self._cond:
+            self._activate_locked()
             return self._seq
 
     def poll(
@@ -180,7 +201,8 @@ class WatchHub:
         deadline = time.monotonic() + timeout
         while True:
             with self._cond:
-                if since < self._dropped.get(kind, 0):
+                self._activate_locked()
+                if since < max(self._dropped.get(kind, 0), self._journal_start):
                     return "gone", [], self._seq
                 # Ring entries are seq-ascending: walk from the right only
                 # as far as `since` — O(new events), not O(ring).

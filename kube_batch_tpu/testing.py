@@ -231,6 +231,15 @@ class FakeBinder:
             self.binds[key] = hostname
         self.channel.put(key)
 
+    def bind_many(self, pairs: list) -> None:
+        """Bulk form: one lock acquisition, same one-signal-per-bind
+        channel contract."""
+        keyed = [(f"{pod.namespace}/{pod.name}", hostname) for pod, hostname in pairs]
+        with self._lock:
+            self.binds.update(keyed)
+        for key, _ in keyed:
+            self.channel.put(key)
+
 
 class FakeEvictor:
     """reference util/test_utils.go:120-140; one signal per evict."""
@@ -348,6 +357,9 @@ class FakeCache:
 
     def bind(self, task, hostname: str) -> None:
         self.binder.bind(task.pod, hostname)
+
+    def bind_many(self, pairs: list) -> None:
+        self.binder.bind_many([(task.pod, hostname) for task, hostname in pairs])
 
     def evict(self, task, reason: str) -> None:
         self.evictor.evict(task.pod)
