@@ -16,8 +16,8 @@ host-side Python time is load-sensitive).
 Serial twins (VERDICT r3 item 2 — measured, not extrapolated):
 - gang_example / 1k x 100 / multi-tenant / 10k x 1k: measured in-run
   (the 10k serial costs ~50 s — the price of an honest twin);
-- 50k x 5k: the serial loop costs ~25 min (O(tasks x nodes) Python at
-  ~11 us/pair), so it is measured when ``KBT_BENCH_FULL_SERIAL=1`` and
+- 50k x 5k: the serial loop costs ~26 min (O(tasks x nodes) Python at
+  ~6 us/pair), so it is measured when ``KBT_BENCH_FULL_SERIAL=1`` and
   otherwise reported from ``SERIAL_MEASURED`` — a number measured with
   that flag on this host class, stamped with its provenance, never
   extrapolated. ``vs_baseline`` is serial_s / xla_s at the 50k x 5k
@@ -70,9 +70,9 @@ tiers:
 # Serial twins measured offline with KBT_BENCH_FULL_SERIAL=1 (one run,
 # however slow — VERDICT r3 item 2). Re-measure by setting the flag.
 SERIAL_MEASURED = {
-    # one uncontended run, 50000 binds equal to the xla path's; ~11 us
+    # one uncontended run, 50000 binds equal to the xla path's; ~6 us
     # per (task,node) pair, linear — consistent with the in-run
-    # 10k x 1k serial twin
+    # 10k x 1k serial twin (10M pairs ≈ 52 s)
     "preempt_50k_5k": {
         "seconds": 1569.5,
         "provenance": "KBT_BENCH_FULL_SERIAL=1, 2026-07-30, bench host",
@@ -210,6 +210,14 @@ def main() -> None:
                 "value": e50k["xla_s"],
                 "unit": "s",
                 "vs_baseline": vs_baseline,
+                # provenance of the serial side of vs_baseline, machine-
+                # readable: "measured" = this run (KBT_BENCH_FULL_SERIAL),
+                # "cached" = the provenance-stamped one-time measurement
+                "baseline_source": (
+                    "measured" if "serial_s_note" not in e50k else "cached"
+                )
+                if serial_50k
+                else None,
             }
         )
     )

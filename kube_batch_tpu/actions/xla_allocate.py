@@ -242,6 +242,14 @@ class XlaAllocateAction(Action):
             return None
         n = min(want, len(devices))
         n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+        # The encoder buckets the node axis to multiples of 128, which
+        # every pow2 mesh up to 128 divides; a larger mesh would break
+        # the GSPMD divisibility invariant.
+        if n > 128:
+            log.warning(
+                "mesh clamped from %d to 128 devices (node-bucket divisibility)", n
+            )
+            n = 128
         if n <= 1:
             if spec != "auto" and want > 1:
                 log.warning(

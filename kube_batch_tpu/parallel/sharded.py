@@ -5,8 +5,9 @@ The encoded snapshot's node-axis arrays are partitioned across the mesh's
 while-loop kernel then runs SPMD: each device evaluates feasibility and
 scores for its node block, GSPMD reduces the argmax across blocks and
 broadcasts the winning assignment's capacity update. Static shapes are
-guaranteed by encode.py's power-of-two padding, so any mesh size that
-divides the node bucket (8 >= any pow2 mesh) shards cleanly.
+guaranteed by encode.py's bucketing — the node axis pads to multiples of
+128 (one lane row), so any power-of-two mesh size up to 128 divides the
+bucket and shards cleanly (the action clamps larger meshes).
 """
 
 from __future__ import annotations
@@ -127,7 +128,7 @@ class ShardedSolver:
         if n_nodes % n != 0:
             raise ValueError(
                 f"node bucket {n_nodes} not divisible by mesh size {n}; "
-                "encode with pad=True (power-of-two buckets)"
+                "encode with pad=True (node buckets are multiples of 128; meshes up to 128 divide them)"
             )
         self.arrays = arrays
         self.mesh = mesh
@@ -195,7 +196,7 @@ def sharded_solve_allocate(
     if n_nodes % n != 0:
         raise ValueError(
             f"node bucket {n_nodes} not divisible by mesh size {n}; "
-            "encode with pad=True (power-of-two buckets)"
+            "encode with pad=True (node buckets are multiples of 128; meshes up to 128 divide them)"
         )
     shardings = node_shardings(arrays, mesh, axis_name)
     fn = jax.jit(
