@@ -53,6 +53,7 @@ from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import Session
 
 from kube_batch_tpu.actions.envelope import kernel_supported as _kernel_supported
+from kube_batch_tpu.native import lib as _native
 
 log = logging.getLogger("kube_batch_tpu.actions.xla_allocate")
 
@@ -497,8 +498,13 @@ class _Replayer:
         # Row-indexed hot lookups for the bulk loop.
         self.task_keys = [f"{t.namespace}/{t.name}" for t in enc.tasks]
         self.node_by_row = [ssn.nodes[name] for name in enc.node_names]
+        self.node_tasks_by_row = [n.tasks for n in self.node_by_row]
         self.replayed = 0  # assignment events already applied
         self.alloc_jobs: set[str] = set()  # jobs with >=1 Allocated event
+        # jobs that took a host-stepped (apply_immediate) event: their
+        # allocated tasks may carry volume claims / binder-managed
+        # volume_ready, so finish() keeps the per-task checks for them
+        self.stepped_jobs: set[str] = set()
         # per-node aggregation buffers (flushed once per segment)
         self._node_buf: dict[int, _NodeDelta] = {}
         self._touched_drf: set[str] = set()
@@ -518,6 +524,7 @@ class _Replayer:
         if kind == KIND_ALLOCATED:
             ssn.cache.allocate_volumes(task, hostname)
             self.alloc_jobs.add(job.uid)
+        self.stepped_jobs.add(job.uid)
 
         # status index surgery == update_task_status's net effect
         pend = job.task_status_index.get(TaskStatus.PENDING)
@@ -667,47 +674,45 @@ class _Replayer:
         # a job, which is what fixes sidx insertion order and therefore
         # dispatch/bind order); the status-index moves then land as one
         # C-level dict.update per (job, status) instead of per-task
-        # get/setdefault (VERDICT r3 item 8, the replay diet).
-        tasks = self.enc.tasks
-        tkeys = self.task_keys
-        node_by_row = self.node_by_row
+        # get/setdefault (VERDICT r3 item 8, the replay diet). The
+        # per-event body itself — status flip, node_name set, residency
+        # clone, node task-map insert — runs in the native module when
+        # built (kube_batch_tpu/native, round-4 replay diet), with the
+        # Python loop as fallback and for volume-carrying rows.
         jobs_l = self.enc.jobs
-        alloc_volumes = self.ssn.cache.allocate_volumes
         ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
         order = np.argsort(compj, kind="stable")
         counts = np.bincount(compj, minlength=touched_j.size).tolist()
         rows_o = rows[order].tolist()
         nrows_o = nrows[order].tolist()
-        alloc_o = alloc[order].tolist()
-        pos = 0
+        segments = None
+        if _native is not None:
+            try:
+                segments = _native.bulk_assign(
+                    self.enc.tasks,
+                    self.task_keys,
+                    self.node_tasks_by_row,
+                    self.enc.node_names,
+                    rows_o,
+                    nrows_o,
+                    alloc[order].astype(np.uint8).tobytes(),
+                    counts,
+                    ALLOCATED,
+                    PIPELINED,
+                )
+            except ValueError:
+                # a bulk row carries volume claims (custom encoder/binder):
+                # the prepass mutated nothing — take the Python path,
+                # which routes those through cache.allocate_volumes
+                segments = None
+        if segments is None:
+            segments = self._assign_segments_py(
+                rows_o, nrows_o, alloc[order].tolist(), counts
+            )
         for k, jrow in enumerate(touched_j.tolist()):
-            cnt = counts[k]
-            end = pos + cnt
+            alloc_d, pipe_d = segments[k]
             sidx = jobs_l[jrow].task_status_index
             pend = sidx.get(TaskStatus.PENDING)
-            alloc_d: dict = {}
-            pipe_d: dict = {}
-            for row, nrow_i, is_alloc in zip(
-                rows_o[pos:end], nrows_o[pos:end], alloc_o[pos:end]
-            ):
-                task = tasks[row]
-                node = node_by_row[nrow_i]
-                if is_alloc:
-                    if task.pod.volumes:
-                        # bulk rows cannot carry claims (encode routes
-                        # volume pods host_only) — guard kept for custom
-                        # encoders/binders
-                        alloc_volumes(task, node.name)
-                    else:
-                        task.volume_ready = True
-                    task.status = ALLOCATED
-                    alloc_d[task.uid] = task
-                else:
-                    task.status = PIPELINED
-                    pipe_d[task.uid] = task
-                task.node_name = node.name
-                node.tasks[tkeys[row]] = task.clone_for_residency()
-            pos = end
             if pend is not None:
                 for uid in alloc_d:
                     pend.pop(uid, None)
@@ -727,6 +732,47 @@ class _Replayer:
                     sidx[PIPELINED] = pipe_d
                 else:
                     d.update(pipe_d)
+
+    def _assign_segments_py(self, rows_o, nrows_o, alloc_o, counts):
+        """Pure-Python twin of native.bulk_assign: per-event status flip,
+        node_name set, residency clone, node task-map insert; returns one
+        (alloc_d, pipe_d) pair per job segment."""
+        tasks = self.enc.tasks
+        tkeys = self.task_keys
+        node_by_row = self.node_by_row
+        alloc_volumes = self.ssn.cache.allocate_volumes
+        ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
+        segments = []
+        pos = 0
+        for cnt in counts:
+            end = pos + cnt
+            alloc_d: dict = {}
+            pipe_d: dict = {}
+            for row, nrow_i, is_alloc in zip(
+                rows_o[pos:end], nrows_o[pos:end], alloc_o[pos:end]
+            ):
+                task = tasks[row]
+                node = node_by_row[nrow_i]
+                if is_alloc:
+                    if task.pod.volumes:
+                        # bulk rows cannot carry claims (encode routes
+                        # volume pods host_only) — guard kept for custom
+                        # encoders/binders; the job keeps finish()'s
+                        # per-task volume checks
+                        alloc_volumes(task, node.name)
+                        self.stepped_jobs.add(task.job)
+                    else:
+                        task.volume_ready = True
+                    task.status = ALLOCATED
+                    alloc_d[task.uid] = task
+                else:
+                    task.status = PIPELINED
+                    pipe_d[task.uid] = task
+                task.node_name = node.name
+                node.tasks[tkeys[row]] = task.clone_for_residency()
+            pos = end
+            segments.append((alloc_d, pipe_d))
+        return segments
 
     def _flush_nodes(self) -> None:
         """Fold the per-node resource deltas into NodeInfo, following
@@ -761,7 +807,7 @@ class _Replayer:
         now = _time.time()
         job_min = self.arrays["job_min"]
         bind_volumes = ssn.cache.bind_volumes
-        durations: list[float] = []
+        BINDING = TaskStatus.BINDING
         to_bind: list = []  # dispatched tasks, in dispatch order
         for i, job in enumerate(self.enc.jobs):
             if job.uid not in self.alloc_jobs:
@@ -770,6 +816,24 @@ class _Replayer:
                 continue
             allocated = job.task_status_index.get(TaskStatus.ALLOCATED)
             if not allocated:
+                continue
+            if job.uid not in self.stepped_jobs:
+                # Pure-bulk gang: every task came through bulk_assign, so
+                # it is volume-less with volume_ready=True — no per-task
+                # checks, one bulk status flip, one bulk index move.
+                dispatched = list(allocated.values())
+                if _native is not None:
+                    _native.bulk_set_slot(dispatched, "status", BINDING)
+                else:
+                    for task in dispatched:
+                        task.status = BINDING
+                to_bind.extend(dispatched)
+                binding = job.task_status_index.setdefault(BINDING, {})
+                binding.update(allocated)
+                job.task_status_index.pop(TaskStatus.ALLOCATED, None)
+                log.debug(
+                    "dispatched gang job %s (%d tasks)", job.uid, int(ready_cnt[i])
+                )
                 continue
             dispatched = []
             failed = False
@@ -787,15 +851,14 @@ class _Replayer:
                             resync(task)
                         failed = True
                         break
-                task.status = TaskStatus.BINDING
+                task.status = BINDING
                 dispatched.append(task)
                 to_bind.append(task)
-                durations.append(max(0.0, now - task.pod.metadata.creation_timestamp))
             # status-index move as one bulk update instead of per-task
             # pop/insert; on a volume failure only the dispatched prefix
             # moves (the rest stay Allocated, exactly like the serial
             # early return).
-            binding = job.task_status_index.setdefault(TaskStatus.BINDING, {})
+            binding = job.task_status_index.setdefault(BINDING, {})
             if not failed:
                 binding.update(allocated)
                 job.task_status_index.pop(TaskStatus.ALLOCATED, None)
@@ -814,7 +877,17 @@ class _Replayer:
         else:
             for t in to_bind:
                 ssn.cache.bind(t, t.node_name)
-        metrics.update_task_schedule_durations(durations)
+        if to_bind:
+            # e2e scheduling latency per dispatched pod, as one vector op
+            # instead of a 50k-iteration max() loop
+            created = np.fromiter(
+                (t.pod.metadata.creation_timestamp for t in to_bind),
+                np.float64,
+                count=len(to_bind),
+            )
+            metrics.update_task_schedule_durations(
+                np.maximum(0.0, now - created)
+            )
 
 
 class _NodeDelta:

@@ -1,0 +1,214 @@
+"""Parity tests for the native (C++) hot loops vs their Python twins.
+
+The native module (kube_batch_tpu/native/_hotloops.cpp) reimplements
+the replay path's per-event session surgery; these tests pin its
+semantics to the pure-Python loop it replaces: identical status
+flips, node_name sets, residency-clone sharing rules
+(api/job_info.py clone_for_residency), status-index dict contents,
+and the mutation-free volume-guard prepass. Skipped wholesale when
+the toolchain cannot build the module (the framework then runs the
+Python loops — same results, slower)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.native import lib
+from kube_batch_tpu.testing import build_task
+
+pytestmark = pytest.mark.skipif(lib is None, reason="native module unavailable")
+
+
+def _mk_tasks(n):
+    return [
+        build_task(namespace="ns", name=f"p{i}", req={"cpu": 1.0}) for i in range(n)
+    ]
+
+
+def _run_python_twin(tasks, tkeys, node_tasks, node_names, rows, nrows, allocs, counts):
+    """The exact loop _Replayer._assign_segments_py runs for volume-less
+    rows (volume rows never reach bulk_assign — the guard test below)."""
+    segments = []
+    pos = 0
+    for cnt in counts:
+        alloc_d, pipe_d = {}, {}
+        for i in range(pos, pos + cnt):
+            task = tasks[rows[i]]
+            if allocs[i]:
+                task.volume_ready = True
+                task.status = TaskStatus.ALLOCATED
+                alloc_d[task.uid] = task
+            else:
+                task.status = TaskStatus.PIPELINED
+                pipe_d[task.uid] = task
+            task.node_name = node_names[nrows[i]]
+            node_tasks[nrows[i]][tkeys[rows[i]]] = task.clone_for_residency()
+        pos += cnt
+        segments.append((alloc_d, pipe_d))
+    return segments
+
+
+class TestBulkAssign:
+    def test_matches_python_twin(self):
+        rng = np.random.default_rng(7)
+        n, n_nodes = 200, 7
+        rows = rng.permutation(n).tolist()
+        nrows = rng.integers(0, n_nodes, n).tolist()
+        allocs = rng.integers(0, 2, n).astype(np.uint8)
+        counts = [50, 100, 0, 50]
+
+        tasks_a, tasks_b = _mk_tasks(n), _mk_tasks(n)
+        tkeys = [f"{t.namespace}/{t.name}" for t in tasks_a]
+        nt_a = [dict() for _ in range(n_nodes)]
+        nt_b = [dict() for _ in range(n_nodes)]
+        nn = [f"node-{i}" for i in range(n_nodes)]
+
+        seg_n = lib.bulk_assign(
+            tasks_a, tkeys, nt_a, nn, rows, nrows, allocs.tobytes(), counts,
+            TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+        )
+        seg_p = _run_python_twin(
+            tasks_b, tkeys, nt_b, nn, rows, nrows, allocs.tolist(), counts
+        )
+
+        assert len(seg_n) == len(seg_p) == 4
+        for (an, pn), (ap, pp) in zip(seg_n, seg_p):
+            assert list(an) == list(ap)  # same uids, same insertion order
+            assert list(pn) == list(pp)
+        for ta, tb in zip(tasks_a, tasks_b):
+            assert ta.status is tb.status
+            assert ta.node_name == tb.node_name
+            assert ta.volume_ready == tb.volume_ready
+        for da, db in zip(nt_a, nt_b):
+            assert list(da) == list(db)
+            for k in da:
+                ca, cb = da[k], db[k]
+                assert ca.status is cb.status and ca.node_name == cb.node_name
+
+    def test_clone_shares_resources_and_detaches_status(self):
+        tasks = _mk_tasks(1)
+        nt = [dict()]
+        lib.bulk_assign(
+            tasks, ["ns/p0"], nt, ["n0"], [0], [0], bytes([1]), [1],
+            TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+        )
+        clone = nt[0]["ns/p0"]
+        t = tasks[0]
+        assert clone is not t
+        assert clone.resreq is t.resreq and clone.init_resreq is t.init_resreq
+        assert clone.pod is t.pod and clone.uid == t.uid
+        assert clone.status is TaskStatus.ALLOCATED
+        t.status = TaskStatus.BINDING  # later dispatch flip
+        assert clone.status is TaskStatus.ALLOCATED  # resident unaffected
+
+    def test_volume_rows_raise_without_mutation(self):
+        tasks = _mk_tasks(2)
+        tasks[1].pod.volumes = ["claim-1"]
+        before = [(t.status, t.node_name, t.volume_ready) for t in tasks]
+        nt = [dict()]
+        with pytest.raises(ValueError, match="volume"):
+            lib.bulk_assign(
+                tasks, ["ns/p0", "ns/p1"], nt, ["n0"], [0, 1], [0, 0],
+                bytes([1, 1]), [2], TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+            )
+        # prepass fired before any event applied: nothing changed
+        assert [(t.status, t.node_name, t.volume_ready) for t in tasks] == before
+        assert not nt[0]
+
+    def test_pipelined_rows_skip_volume_guard(self):
+        # only Allocated events bind volumes; a Pipelined volume row is fine
+        tasks = _mk_tasks(1)
+        tasks[0].pod.volumes = ["claim-1"]
+        nt = [dict()]
+        lib.bulk_assign(
+            tasks, ["ns/p0"], nt, ["n0"], [0], [0], bytes([0]), [1],
+            TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+        )
+        assert tasks[0].status is TaskStatus.PIPELINED
+        assert not tasks[0].volume_ready
+
+    def test_clone_survives_collection(self):
+        import gc
+
+        tasks = _mk_tasks(3)
+        nt = [dict()]
+        lib.bulk_assign(
+            tasks, [f"ns/p{i}" for i in range(3)], nt, ["n0"], [0, 1, 2],
+            [0, 0, 0], bytes([1, 1, 1]), [3],
+            TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+        )
+        clones = dict(nt[0])
+        del tasks, nt
+        gc.collect()  # clones are GC-untracked; refcounting must keep them
+        for k, c in clones.items():
+            assert c.uid and c.status is TaskStatus.ALLOCATED
+
+    def test_length_mismatch_rejected(self):
+        tasks = _mk_tasks(1)
+        with pytest.raises(ValueError):
+            lib.bulk_assign(
+                tasks, ["ns/p0"], [{}], ["n0"], [0, 0], [0], bytes([1]), [1],
+                TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+            )
+        with pytest.raises(IndexError):
+            lib.bulk_assign(
+                tasks, ["ns/p0"], [{}], ["n0"], [5], [0], bytes([1]), [1],
+                TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+            )
+
+
+class TestBulkSetSlot:
+    def test_sets_every_object(self):
+        tasks = _mk_tasks(50)
+        lib.bulk_set_slot(tasks, "status", TaskStatus.BINDING)
+        assert all(t.status is TaskStatus.BINDING for t in tasks)
+
+    def test_non_slot_attr_rejected(self):
+        with pytest.raises(AttributeError):
+            lib.bulk_set_slot(_mk_tasks(1), "not_a_slot", 1)
+        with pytest.raises(TypeError):
+            # exists on the type but is a method, not a member slot
+            lib.bulk_set_slot(_mk_tasks(1), "clone", 1)
+
+    def test_empty_list_ok(self):
+        lib.bulk_set_slot([], "status", TaskStatus.BINDING)
+
+
+class TestHistogramNdarrayPath:
+    def test_matches_scalar_observe(self):
+        from kube_batch_tpu.metrics import Histogram
+
+        buckets = [0.1, 1.0, 10.0]
+        h1, h2 = Histogram("a", "", buckets), Histogram("b", "", buckets)
+        vals = [0.05, 0.1, 0.5, 1.0, 5.0, 50.0]
+        for v in vals:
+            h1.observe(v)
+        h2.observe_many(np.asarray(vals))
+        assert h1.snapshot() == h2.snapshot()
+
+
+class TestActionUsesNative:
+    def test_xla_allocate_with_and_without_native_agree(self, monkeypatch):
+        """The full action, native path vs forced-Python path, must
+        produce identical binds and session state on a gang cluster."""
+        import kube_batch_tpu.actions.xla_allocate as XA
+        from kube_batch_tpu.conf import parse_scheduler_conf
+        from kube_batch_tpu.framework import close_session, get_action, open_session
+        from kube_batch_tpu.models import synthetic
+        from kube_batch_tpu.testing import FakeCache
+        from bench import TIERS_YAML
+
+        def run():
+            cache = FakeCache(synthetic(120, 16))
+            ssn = open_session(cache, parse_scheduler_conf(TIERS_YAML).tiers)
+            get_action("xla_allocate").execute(ssn)
+            binds = dict(cache.binder.binds)
+            close_session(ssn)
+            return binds
+
+        native_binds = run()
+        monkeypatch.setattr(XA, "_native", None)
+        python_binds = run()
+        assert native_binds == python_binds and len(native_binds) > 0
