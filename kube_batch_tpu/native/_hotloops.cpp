@@ -57,8 +57,12 @@ SlotCache g_task_slots;
 
 /* Resolve the byte offset of each __slots__ member descriptor on `tp`.
  * Returns 0 on success, -1 (with a Python error set) when any name is
- * not a plain member slot — the caller then uses the Python path. */
+ * not a plain member slot — the caller then uses the Python path.
+ * Resolves into a local table and commits atomically so a mid-loop
+ * failure cannot leave a half-overwritten cache behind a stale type
+ * identity. */
 int resolve_slots(PyTypeObject* tp, SlotCache* cache) {
+  SlotCache local;
   for (int i = 0; i < kNumSlots; i++) {
     PyObject* descr = PyObject_GetAttrString((PyObject*)tp, kSlotNames[i]);
     if (descr == nullptr) return -1;
@@ -68,10 +72,11 @@ int resolve_slots(PyTypeObject* tp, SlotCache* cache) {
                    tp->tp_name, kSlotNames[i]);
       return -1;
     }
-    cache->off[i] = ((PyMemberDescrObject*)descr)->d_member->offset;
+    local.off[i] = ((PyMemberDescrObject*)descr)->d_member->offset;
     Py_DECREF(descr);
   }
-  cache->type = tp;
+  local.type = tp;
+  *cache = local;
   return 0;
 }
 
@@ -264,6 +269,252 @@ fail_ix:
   return nullptr;
 }
 
+/* ---- encode-side extractors ---------------------------------------------- */
+
+constexpr int kSlotJob = 1;
+constexpr int kSlotResreq = 4;
+constexpr int kSlotInitResreq = 5;
+
+/* Resource slots (api/resource_info.py). */
+constexpr int kNumResSlots = 3;
+const char* const kResSlotNames[kNumResSlots] = {"milli_cpu", "memory",
+                                                 "scalars"};
+struct ResSlotCache {
+  PyTypeObject* type = nullptr;
+  Py_ssize_t off[kNumResSlots];
+};
+ResSlotCache g_res_slots;
+
+int resolve_res_slots(PyTypeObject* tp, ResSlotCache* cache) {
+  ResSlotCache local;  // committed atomically; see resolve_slots
+  for (int i = 0; i < kNumResSlots; i++) {
+    PyObject* descr = PyObject_GetAttrString((PyObject*)tp, kResSlotNames[i]);
+    if (descr == nullptr) return -1;
+    if (Py_TYPE(descr) != &PyMemberDescr_Type) {
+      Py_DECREF(descr);
+      PyErr_Format(PyExc_TypeError, "%s.%s is not a slot member",
+                   tp->tp_name, kResSlotNames[i]);
+      return -1;
+    }
+    local.off[i] = ((PyMemberDescrObject*)descr)->d_member->offset;
+    Py_DECREF(descr);
+  }
+  local.type = tp;
+  *cache = local;
+  return 0;
+}
+
+struct F32F64Buf {
+  Py_buffer view{};
+  bool is_f64 = false;
+  bool ok = false;
+};
+
+/* Acquire a writable C-contiguous float32/float64 buffer. */
+bool get_float_buf(PyObject* obj, F32F64Buf* b, int want_ndim) {
+  if (PyObject_GetBuffer(obj, &b->view, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE |
+                                            PyBUF_FORMAT) < 0)
+    return false;
+  b->ok = true;
+  const char* f = b->view.format;
+  if (b->view.ndim != want_ndim || f == nullptr ||
+      !((f[0] == 'f' || f[0] == 'd') && f[1] == '\0')) {
+    PyErr_SetString(PyExc_TypeError,
+                    "expected a C-contiguous float32/float64 buffer");
+    return false;
+  }
+  b->is_f64 = b->view.format[0] == 'd';
+  return true;
+}
+
+inline void put_f(const F32F64Buf& b, Py_ssize_t flat_ix, double v) {
+  if (b.is_f64)
+    ((double*)b.view.buf)[flat_ix] = v;
+  else
+    ((float*)b.view.buf)[flat_ix] = (float)v;
+}
+
+/* Read resource.milli_cpu / resource.memory as doubles; -1 on error. */
+inline int res_cpu_mem(PyObject* res, const ResSlotCache& rc, double* cpu,
+                       double* mem) {
+  PyObject* c = get_slot(res, rc.off[0]);
+  PyObject* m = get_slot(res, rc.off[1]);
+  if (c == nullptr || m == nullptr) {
+    PyErr_SetString(PyExc_AttributeError, "Resource slot unset");
+    return -1;
+  }
+  *cpu = PyFloat_AsDouble(c);
+  if (*cpu == -1.0 && PyErr_Occurred()) return -1;
+  *mem = PyFloat_AsDouble(m);
+  if (*mem == -1.0 && PyErr_Occurred()) return -1;
+  return 0;
+}
+
+/* extract_task_columns(tasks, job_idx, req, res, job_out, has_sc,
+ *                      res_has_sc)
+ *
+ * The scalar-less encoder fast path (ops/encode.py): for task i write
+ *   req[i,0:2]  = init_resreq.{milli_cpu,memory}
+ *   res[i,0:2]  = resreq.{milli_cpu,memory}
+ *   job_out[i]  = job_idx[task.job]          (int32)
+ *   has_sc[i]   = bool(init_resreq.scalars)  (uint8/bool)
+ *   res_has_sc[i] = bool(resreq.scalars)
+ * req/res are the [T,R] padded arrays (T >= len(tasks)); only the first
+ * len(tasks) rows and two columns are touched. */
+PyObject* extract_task_columns(PyObject*, PyObject* args) {
+  PyObject *tasks, *job_idx, *req_o, *res_o, *job_o, *hs_o, *rhs_o;
+  if (!PyArg_ParseTuple(args, "O!O!OOOOO", &PyList_Type, &tasks, &PyDict_Type,
+                        &job_idx, &req_o, &res_o, &job_o, &hs_o, &rhs_o))
+    return nullptr;
+
+  F32F64Buf req, res;
+  Py_buffer job_b{}, hs_b{}, rhs_b{};
+  bool job_ok = false, hs_ok = false, rhs_ok = false;
+  PyObject* ret = nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(tasks);
+
+  if (!get_float_buf(req_o, &req, 2) || !get_float_buf(res_o, &res, 2))
+    goto done;
+  if (PyObject_GetBuffer(job_o, &job_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  job_ok = true;
+  if (PyObject_GetBuffer(hs_o, &hs_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  hs_ok = true;
+  if (PyObject_GetBuffer(rhs_o, &rhs_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  rhs_ok = true;
+
+  if (job_b.itemsize != 4 || hs_b.itemsize != 1 || rhs_b.itemsize != 1 ||
+      req.view.shape[0] < n || res.view.shape[0] < n || job_b.len < 4 * n ||
+      hs_b.len < n || rhs_b.len < n || req.view.shape[1] < 2 ||
+      res.view.shape[1] < 2) {
+    PyErr_SetString(PyExc_ValueError, "output buffer shape/dtype mismatch");
+    goto done;
+  }
+
+  {
+    Py_ssize_t req_R = req.view.shape[1], res_R = res.view.shape[1];
+    int32_t* job_out = (int32_t*)job_b.buf;
+    char* hs = (char*)hs_b.buf;
+    char* rhs = (char*)rhs_b.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* task = PyList_GET_ITEM(tasks, i);
+      PyTypeObject* tp = Py_TYPE(task);
+      if (g_task_slots.type != tp && resolve_slots(tp, &g_task_slots) < 0)
+        goto done;
+      const SlotCache& sc = g_task_slots;
+      PyObject* rr = get_slot(task, sc.off[kSlotResreq]);
+      PyObject* ir = get_slot(task, sc.off[kSlotInitResreq]);
+      if (rr == nullptr || ir == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "task resource slot unset");
+        goto done;
+      }
+      PyTypeObject* rtp = Py_TYPE(rr);
+      if (g_res_slots.type != rtp && resolve_res_slots(rtp, &g_res_slots) < 0)
+        goto done;
+      if (Py_TYPE(ir) != g_res_slots.type) {
+        PyErr_SetString(PyExc_TypeError, "mixed Resource types");
+        goto done;
+      }
+      const ResSlotCache& rc = g_res_slots;
+      double cpu, mem;
+      if (res_cpu_mem(ir, rc, &cpu, &mem) < 0) goto done;
+      put_f(req, i * req_R + 0, cpu);
+      put_f(req, i * req_R + 1, mem);
+      if (res_cpu_mem(rr, rc, &cpu, &mem) < 0) goto done;
+      put_f(res, i * res_R + 0, cpu);
+      put_f(res, i * res_R + 1, mem);
+      PyObject* jid = get_slot(task, sc.off[kSlotJob]);
+      PyObject* jrow = jid ? PyDict_GetItemWithError(job_idx, jid) : nullptr;
+      if (jrow == nullptr) {
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_KeyError, "task.job not in job_idx");
+        goto done;
+      }
+      long j = PyLong_AsLong(jrow);
+      if (j == -1 && PyErr_Occurred()) goto done;
+      job_out[i] = (int32_t)j;
+      int t1 = PyObject_IsTrue(get_slot(ir, rc.off[2]));
+      int t2 = PyObject_IsTrue(get_slot(rr, rc.off[2]));
+      if (t1 < 0 || t2 < 0) goto done;
+      hs[i] = (char)t1;
+      rhs[i] = (char)t2;
+    }
+  }
+  ret = Py_NewRef(Py_None);
+
+done:
+  if (req.ok) PyBuffer_Release(&req.view);
+  if (res.ok) PyBuffer_Release(&res.view);
+  if (job_ok) PyBuffer_Release(&job_b);
+  if (hs_ok) PyBuffer_Release(&hs_b);
+  if (rhs_ok) PyBuffer_Release(&rhs_b);
+  return ret;
+}
+
+/* extract_node_columns(nodes, names, out) — the node-side scalar-less
+ * fast path: nodes is list[NodeInfo], names a tuple of attribute names
+ * (e.g. ("idle","releasing","used","allocatable")), out a writable
+ * [len(names), N, R] float buffer; writes out[a, i, 0:2] =
+ * node.<names[a]>.{milli_cpu,memory}. */
+PyObject* extract_node_columns(PyObject*, PyObject* args) {
+  PyObject *nodes, *names, *out_o;
+  if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &nodes, &PyTuple_Type,
+                        &names, &out_o))
+    return nullptr;
+  F32F64Buf out;
+  PyObject* ret = nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(nodes);
+  Py_ssize_t na = PyTuple_GET_SIZE(names);
+  if (PyObject_GetBuffer(out_o, &out.view, PyBUF_C_CONTIGUOUS |
+                                               PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    return nullptr;
+  out.ok = true;
+  {
+    const char* f = out.view.format;
+    if (out.view.ndim != 3 || f == nullptr ||
+        !((f[0] == 'f' || f[0] == 'd') && f[1] == '\0') ||
+        out.view.shape[0] != na || out.view.shape[1] < n ||
+        out.view.shape[2] < 2) {
+      PyErr_SetString(PyExc_ValueError, "output buffer shape/dtype mismatch");
+      goto done;
+    }
+    out.is_f64 = f[0] == 'd';
+    Py_ssize_t N = out.view.shape[1], R = out.view.shape[2];
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* node = PyList_GET_ITEM(nodes, i);
+      for (Py_ssize_t a = 0; a < na; a++) {
+        PyObject* res = PyObject_GetAttr(node, PyTuple_GET_ITEM(names, a));
+        if (res == nullptr) goto done;
+        PyTypeObject* rtp = Py_TYPE(res);
+        if (g_res_slots.type != rtp &&
+            resolve_res_slots(rtp, &g_res_slots) < 0) {
+          Py_DECREF(res);
+          goto done;
+        }
+        double cpu, mem;
+        int rc = res_cpu_mem(res, g_res_slots, &cpu, &mem);
+        Py_DECREF(res);
+        if (rc < 0) goto done;
+        put_f(out, (a * N + i) * R + 0, cpu);
+        put_f(out, (a * N + i) * R + 1, mem);
+      }
+    }
+  }
+  ret = Py_NewRef(Py_None);
+done:
+  PyBuffer_Release(&out.view);
+  return ret;
+}
+
 /* ---- bulk_set_slot ------------------------------------------------------- */
 
 /* bulk_set_slot(objs, name, value): obj.<name> = value for every obj —
@@ -304,6 +555,10 @@ PyMethodDef methods[] = {
      "Apply kernel assignment events to session TaskInfo/node state."},
     {"bulk_set_slot", bulk_set_slot, METH_VARARGS,
      "Set one __slots__ attribute on every object in a list."},
+    {"extract_task_columns", extract_task_columns, METH_VARARGS,
+     "Fill SoA request/limit/job/scalar-flag columns from TaskInfos."},
+    {"extract_node_columns", extract_node_columns, METH_VARARGS,
+     "Fill [A,N,R] cpu/mem columns from NodeInfo resource attributes."},
     {nullptr, nullptr, 0, nullptr},
 };
 

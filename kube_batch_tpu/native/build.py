@@ -30,7 +30,7 @@ def ensure(verbose: bool = False) -> str:
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(SOURCE):
         return out
     include = sysconfig.get_paths()["include"]
-    tmp = out + ".tmp"
+    tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent builds race on os.replace, not on the write
     cmd = [
         "g++",
         "-O2",

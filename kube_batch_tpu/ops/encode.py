@@ -56,6 +56,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from kube_batch_tpu.native import lib as _native
+
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.queue_info import QueueInfo
@@ -70,6 +72,21 @@ from kube_batch_tpu.plugins.predicates import (
     check_pressure,
     check_taints,
 )
+
+
+_warned_native_fallback: set[str] = set()
+
+
+def _log_native_fallback(fn: str) -> None:
+    """A native extractor failing is a defect signal (the slow path is
+    correct, so it must not be silent) — log it once per function."""
+    if fn not in _warned_native_fallback:
+        _warned_native_fallback.add(fn)
+        import logging
+
+        logging.getLogger("kube_batch_tpu.ops.encode").warning(
+            "native %s failed; using the numpy encode path", fn, exc_info=True
+        )
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -115,23 +132,6 @@ def _task_signature(task: TaskInfo, with_labels: bool = False) -> tuple:
         tuple(sorted(repr(t) for t in pod.tolerations)),
         tuple(sorted(pod.metadata.labels.items())) if with_labels else (),
     )
-
-
-def _referenced_label_keys(tasks: Sequence[TaskInfo]) -> frozenset[str]:
-    """Label keys the pending tasks' selectors / node-affinity terms can
-    actually read. Node signatures project labels onto this set so
-    per-node unique labels (hostname et al) do not defeat node-group
-    deduplication (ADVICE r2: encode.py finding)."""
-    keys: set[str] = set()
-    for t in tasks:
-        keys.update(t.pod.node_selector)
-        aff = t.pod.affinity
-        if aff is not None:
-            for term in aff.node_affinity_required:
-                keys.add(term.key)
-            for _, term in aff.node_affinity_preferred:
-                keys.add(term.key)
-    return frozenset(keys)
 
 
 def _node_signature(node: NodeInfo, label_keys: frozenset[str]) -> tuple:
@@ -286,6 +286,12 @@ def encode_session(
     host_only: list[TaskInfo] = []
     job_ranges: list[tuple[int, int]] = []
     host_only_rows: list[int] = []
+    # Label keys the pending tasks' selectors / node-affinity terms can
+    # actually read, collected inline (one pass instead of a separate
+    # _referenced_label_keys sweep). Node signatures project labels onto
+    # this set so per-node unique labels (hostname et al) do not defeat
+    # node-group deduplication (ADVICE r2: encode.py finding).
+    ref_label_keys: set[str] = set()
     for job in job_list:
         pending = job_pending[job.uid]
         # Within-job pop order = priority desc, creation, uid (priority
@@ -295,7 +301,15 @@ def encode_session(
         )
         start = len(task_list)
         for t in pending:
-            aff = t.pod.affinity
+            pod = t.pod
+            if pod.node_selector:
+                ref_label_keys.update(pod.node_selector)
+            aff = pod.affinity
+            if aff is not None:
+                for term in aff.node_affinity_required:
+                    ref_label_keys.add(term.key)
+                for _, term in aff.node_affinity_preferred:
+                    ref_label_keys.add(term.key)
             if aff is not None and aff.has_pod_affinity_terms():
                 # required terms gate feasibility pairwise; preferred terms
                 # change *other* tasks' scores once this pod lands (the
@@ -303,7 +317,7 @@ def encode_session(
                 # host-side against the live session
                 host_only.append(t)
                 host_only_rows.append(len(task_list))
-            elif getattr(t.pod, "volumes", None):
+            elif pod.volumes:
                 # claims need the volume binder's assume step (PV
                 # topology, capacity, class matching) against live PVC/PV
                 # state — serial-stepped host-side like the reference's
@@ -341,7 +355,7 @@ def encode_session(
     P = max(len(interesting_ports), 1)
 
     # -- predicate / affinity groups ----------------------------------------
-    label_keys = _referenced_label_keys(task_list)
+    label_keys = frozenset(ref_label_keys)
     t_groups: dict[tuple, int] = {}
     task_gid = np.zeros(T, np.int32)
     t_reps: list[TaskInfo] = []
@@ -383,7 +397,19 @@ def encode_session(
     task_res_has_sc = np.zeros(T, bool)
     task_host_only = np.zeros(T, bool)
     task_ports = np.zeros((T, P), bool)
-    if t_n:
+    filled = False
+    if t_n and not scalar_names and _native is not None:
+        # native single pass: req/res cpu+mem columns, job row index,
+        # scalar-presence flags (kube_batch_tpu/native extract_task_columns)
+        try:
+            _native.extract_task_columns(
+                task_list, job_idx, task_req, task_res,
+                task_job, task_has_sc, task_res_has_sc,
+            )
+            filled = True
+        except Exception:  # noqa: BLE001 -- fall back to the numpy passes
+            _log_native_fallback("extract_task_columns")
+    if t_n and not filled:
         if scalar_names:
             task_req[:t_n] = np.asarray(
                 [t.init_resreq.to_vector(scalar_names) for t in task_list], dtype
@@ -415,6 +441,7 @@ def encode_session(
         task_res_has_sc[:t_n] = np.fromiter(
             (bool(t.resreq.scalars) for t in task_list), bool, count=t_n
         )
+    if t_n:
         if interesting_ports:
             for i, t in enumerate(task_list):
                 for p in _task_ports(t):
@@ -433,11 +460,29 @@ def encode_session(
     node_idle_has_sc = np.zeros(N, bool)
     node_rel_has_sc = np.zeros(N, bool)
     node_ports = np.zeros((N, P), bool)
+    node_vecs_filled = False
+    if n_n and not scalar_names and _native is not None:
+        # native pass over the 4 per-node resource vectors (cpu+mem)
+        stacked = np.zeros((4, N, R), dtype)
+        try:
+            _native.extract_node_columns(
+                node_list, ("idle", "releasing", "used", "allocatable"), stacked
+            )
+            node_idle, node_rel, node_used, node_alloc = (
+                np.ascontiguousarray(stacked[0]),
+                np.ascontiguousarray(stacked[1]),
+                np.ascontiguousarray(stacked[2]),
+                np.ascontiguousarray(stacked[3]),
+            )
+            node_vecs_filled = True
+        except Exception:  # noqa: BLE001 -- fall back to to_vector rows
+            _log_native_fallback("extract_node_columns")
     for i, n in enumerate(node_list):
-        node_idle[i] = n.idle.to_vector(scalar_names)
-        node_rel[i] = n.releasing.to_vector(scalar_names)
-        node_used[i] = n.used.to_vector(scalar_names)
-        node_alloc[i] = n.allocatable.to_vector(scalar_names)
+        if not node_vecs_filled:
+            node_idle[i] = n.idle.to_vector(scalar_names)
+            node_rel[i] = n.releasing.to_vector(scalar_names)
+            node_used[i] = n.used.to_vector(scalar_names)
+            node_alloc[i] = n.allocatable.to_vector(scalar_names)
         node_ok[i] = (
             n.node is not None
             and check_node_condition(n.node)
