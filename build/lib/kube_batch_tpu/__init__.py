@@ -1,0 +1,27 @@
+"""kube_batch_tpu — a TPU-native batch/gang scheduling framework.
+
+Re-implements the full capability surface of kube-batch (reference:
+/root/reference, a Go gang scheduler for Kubernetes) with the scheduling
+core redesigned for JAX/XLA: the cluster snapshot is encoded as dense
+task x node resource tensors and the allocate/backfill/preempt decisions
+are computed as a vectorized bin-packing solve under ``jax.jit`` on TPU.
+
+Layer map (mirrors reference SURVEY.md section 1):
+
+- ``apis``      — L0 object model (PodGroup, Queue, Pod-like specs)
+- ``api``       — L3 in-memory scheduling model (Resource, TaskInfo, ...)
+- ``cache``     — L2 cluster-state cache (event handlers, snapshot)
+- ``framework`` — L4 session + extension-point registry
+- ``actions``   — L5a pipeline stages (enqueue/allocate/backfill/preempt/reclaim)
+- ``plugins``   — L5b policies (priority/gang/drf/proportion/predicates/nodeorder/conformance)
+- ``ops``       — the TPU compute path: snapshot->tensor encoder + vectorized kernels
+- ``parallel``  — device mesh / sharding for multi-chip solves
+- ``models``    — synthetic workload generators (gang, TFJob/MPIJob mixes)
+- ``utils``     — priority queue + scheduler helpers
+- ``conf``      — scheduler configuration schema + loader
+- ``metrics``   — latency histograms / counters
+- ``cli``       — queue CLI
+- ``server``    — process entry / scheduler loop driver
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""L5a actions — the pipeline stages (reference pkg/scheduler/actions/).
+
+Importing this package registers every built-in action with the framework
+registry (reference actions/factory.go:29-35).
+"""
+
+from kube_batch_tpu.actions.factory import register_all_actions
+
+register_all_actions()

@@ -1,0 +1,88 @@
+"""Policy-envelope checks shared by the vectorized actions.
+
+The device/vector paths (xla_allocate's fused solve, the VectorScan
+behind xla_preempt/xla_reclaim) hardwire the reference's *default* conf
+semantics: priority/gang ordering, drf/proportion shares, the built-in
+predicate chain (predicates.go:57-203) and the nodeorder score formulas
+(nodeorder.go:155-222). A conf that registers anything else — an unknown
+plugin contributing predicate or node-order fns, or a non-default enable
+flag — would make the vector paths silently diverge from the serial
+oracle, so every vectorized action checks its envelope here and falls
+back to the serial action for the cycle when outside it.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.framework.session import Session
+
+# Plugins whose session hooks the vector paths model exactly (priority/
+# gang ordering + barrier, drf/proportion shares, predicates masks,
+# nodeorder score) or that register nothing the allocate/preempt/reclaim
+# scans consult beyond victim vetting, which stays host-side
+# (conformance).
+SUPPORTED_PLUGINS = {
+    "priority",
+    "gang",
+    "conformance",
+    "drf",
+    "predicates",
+    "proportion",
+    "nodeorder",
+    "tensorscore",  # nodeorder's scores served as vectors — same policy
+}
+
+# The per-plugin enable flags the conf schema knows (conf/__init__.py);
+# the vector paths model the all-defaults (True) configuration of each.
+ENABLE_FLAGS = (
+    "enabled_job_order",
+    "enabled_job_ready",
+    "enabled_job_pipelined",
+    "enabled_task_order",
+    "enabled_preemptable",
+    "enabled_reclaimable",
+    "enabled_queue_order",
+    "enabled_predicate",
+    "enabled_node_order",
+)
+
+
+def scan_supported(ssn: Session) -> bool:
+    """True when every configured plugin's predicate/score contribution is
+    one the vectorized node scan models (VectorScan hardcodes the built-in
+    predicate set and the nodeorder/tensorscore score formulas). Tier
+    *order* does not matter here — preempt/reclaim control flow stays
+    host-side and reads the session fn chains directly — but the
+    predicates plugin must be *present*: without it the serial chain
+    treats every node as feasible while the scan would still apply the
+    hardwired masks."""
+    names = []
+    for tier in ssn.tiers:
+        for option in tier.plugins:
+            if option.name not in SUPPORTED_PLUGINS:
+                return False
+            if not all(getattr(option, flag, True) for flag in ENABLE_FLAGS):
+                return False
+            names.append(option.name)
+    return "predicates" in names
+
+
+def kernel_supported(ssn: Session) -> bool:
+    """True when the tiers describe exactly the policy the allocate kernel
+    models: every plugin in the supported set with default enable flags
+    (`scan_supported`), plus the job-order chain reading
+    priority -> gang -> (drf) and predicates present for the masks. The
+    reference's default conf (util.go:31-42) passes. Anything else would
+    make the kernel silently diverge from the serial oracle, so the
+    action falls back."""
+    if not scan_supported(ssn):
+        return False
+    order = [o.name for tier in ssn.tiers for o in tier.plugins]
+    if "priority" not in order or "gang" not in order or "predicates" not in order:
+        return False
+    if order.index("priority") > order.index("gang"):
+        return False
+    # drf's job-order key sits after priority and gang in the kernel's
+    # selection tuple; a conf ordering drf earlier would chain differently.
+    if "drf" in order and order.index("drf") < order.index("gang"):
+        return False
+    return True

@@ -1,0 +1,935 @@
+"""xla_allocate action: the allocate loop as one device program.
+
+Drop-in replacement for the serial allocate action (conf
+``actions: "enqueue, xla_allocate, backfill"``): encodes the session
+snapshot to SoA tensors (ops.encode), runs the gang-aware device solve —
+the fused Pallas kernel (ops.pallas_solve) on TPU, the jitted XLA
+`lax.while_loop` twin (ops.kernels.solve_allocate) elsewhere and as the
+runtime fallback — which vectorizes the reference's per-task node scans
+(scheduler_helper.go:34-109) over the whole node axis, then
+**bulk-replays** the resulting assignments into the session — the same
+state mutations `ssn.allocate`/`ssn.pipeline` would make (status index
+moves, node accounting, drf/proportion event bookkeeping, the gang
+dispatch barrier with cache binds), applied in kernel assignment order
+but without 50k Python call frames of per-task session machinery.
+
+Policy envelope: the kernel hardwires the reference's *default* conf
+semantics (util.go:31-42) — priority/gang ordering + barrier, drf job
+shares, proportion queue shares + overused gate, predicates masks,
+nodeorder scores. Anything else (extra plugins, disabled enable flags,
+a chain order the kernel's selection keys do not model) falls back to
+the serial action for the cycle — correctness first.
+
+Pod (anti-)affinity is pairwise-dynamic over resident pods
+(predicates.go:187-199) and stays host-side, but no longer forces a
+wholesale fallback: the kernel pauses when a flagged task reaches the
+head of its job (ops/kernels.py `paused_at`), the action replays the
+segment, serial-steps that one task against the live session (identical
+to the serial inner loop, allocate.go:139-180), patches the solver state
+and resumes — a snapshot with one affinity task costs one extra device
+round-trip, not a serial cycle.
+
+NodesFitDelta diagnostics (allocate.go:139-145,162-168) are reproduced
+only on the host-stepped tasks — they are human-readable FitError text,
+not policy.
+
+Float dtype (round-2 advisor finding): float64 by default — bit-identical
+to the serial float64 path. When x64 is unavailable (default TPU config)
+the action runs float32 — exact for milli-CPU/MiB-granular quantities but
+able to flip least-requested/balanced floor/tie boundaries on off-grid
+values — and logs that it did so.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax  # noqa: F401  -- fail registration, not mid-cycle, when absent
+import numpy as np
+
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import Session
+
+from kube_batch_tpu.actions.envelope import kernel_supported as _kernel_supported
+from kube_batch_tpu.native import lib as _native
+
+log = logging.getLogger("kube_batch_tpu.actions.xla_allocate")
+
+
+def _nodeorder_weights(ssn: Session) -> tuple[float, float, float, float]:
+    """(w_least, w_balanced, w_aff, w_podaff) from the tiers, matching the
+    serial plugin's defaults (nodeorder.go:139-153)."""
+    from kube_batch_tpu.framework.arguments import Arguments
+    from kube_batch_tpu.plugins.nodeorder import (
+        BALANCED_RESOURCE_WEIGHT,
+        LEAST_REQUESTED_WEIGHT,
+        NODE_AFFINITY_WEIGHT,
+        POD_AFFINITY_WEIGHT,
+    )
+
+    for tier in ssn.tiers:
+        for option in tier.plugins:
+            if option.name in ("nodeorder", "tensorscore") and option.enabled_node_order:
+                args = Arguments(option.arguments)
+                return (
+                    args.get_int(LEAST_REQUESTED_WEIGHT, 1),
+                    args.get_int(BALANCED_RESOURCE_WEIGHT, 1),
+                    args.get_int(NODE_AFFINITY_WEIGHT, 1),
+                    args.get_int(POD_AFFINITY_WEIGHT, 1),
+                )
+    return 0.0, 0.0, 0.0, 0.0
+
+
+class XlaAllocateAction(Action):
+    """The TPU-native allocate. Falls back to serial when out of envelope."""
+
+    def __init__(self, dtype=None) -> None:
+        self._dtype = dtype
+        self._warned_f32 = False
+        # Wall-clock split of the last execute() (bench.py reads this).
+        self.last_timings: dict[str, float] = {}
+        # Devices in the mesh the last execute() resolved (1 = single-chip);
+        # the driver dryrun asserts on this to prove the sharded path ran.
+        self.last_mesh_size = 1
+
+    @property
+    def name(self) -> str:
+        return "xla_allocate"
+
+    # -- main ----------------------------------------------------------------
+
+    def execute(self, ssn: Session) -> None:
+        from kube_batch_tpu.ops.encode import encode_session
+        from kube_batch_tpu.ops.kernels import result_of, solve_allocate_state
+
+        if not _kernel_supported(ssn):
+            log.info("conf outside kernel envelope; running serial allocate")
+            self._fallback(ssn)
+            return
+
+        import jax.numpy as jnp
+
+        dtype = self._dtype
+        if dtype is None:
+            if jnp.zeros(0).dtype == np.float64:
+                dtype = np.float64
+            else:
+                dtype = np.float32
+                if not self._warned_f32:
+                    log.warning(
+                        "jax x64 disabled: solving in float32 — exact on "
+                        "milli-CPU/MiB-granular requests, but off-grid values "
+                        "can flip score floor/tie boundaries vs the serial "
+                        "float64 path (enable jax_enable_x64 for bit parity)"
+                    )
+                    self._warned_f32 = True
+
+        import time as _time
+
+        order = [o.name for t in ssn.tiers for o in t.plugins]
+        enable_drf = "drf" in order
+        enable_proportion = "proportion" in order
+
+        t0 = _time.perf_counter()
+        enc = encode_session(
+            ssn.jobs,
+            ssn.nodes,
+            ssn.queues,
+            dtype=dtype,
+            drf=ssn.plugins.get("drf") if enable_drf else None,
+            proportion=ssn.plugins.get("proportion") if enable_proportion else None,
+        )
+        if not enc.tasks:
+            return
+        t_encode = _time.perf_counter() - t0
+
+        w_least, w_balanced, w_aff, w_podaff = _nodeorder_weights(ssn)
+        arrays = dict(enc.arrays)
+        arrays["w_least"] = dtype(w_least)
+        arrays["w_balanced"] = dtype(w_balanced)
+        arrays["w_aff"] = dtype(w_aff)
+        arrays["w_podaff"] = dtype(w_podaff)
+
+        replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
+
+        mesh = self._resolve_mesh(ssn)
+        solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype, mesh)
+
+        t0 = _time.perf_counter()
+        state = solve_fn(None)
+        while int(state.paused_at) >= 0:
+            # Segmented hybrid: sync the session up to the pause point,
+            # serial-step the host-only task, resume the kernel.
+            s = jax.tree_util.tree_map(np.array, state)  # writable host copy
+            replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
+            s = self._host_step(ssn, enc, arrays, replay, s)
+            if enc.interpod_active:
+                # the host-stepped pod carries pod-affinity terms; once
+                # resident it shifts every group's InterPodAffinity score
+                from kube_batch_tpu.ops.encode import compute_pod_sc
+
+                arrays["pod_sc"] = compute_pod_sc(
+                    enc.task_reps,
+                    ssn.nodes,
+                    enc.node_names,
+                    arrays["pod_sc"].shape[1],
+                    dtype,
+                )
+            state = solve_fn(s)
+
+        result = result_of(state)
+        assign_pos = np.asarray(result.assign_pos)
+        t_solve = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        assigned_node = np.asarray(result.assigned_node)
+        assigned_kind = np.asarray(result.assigned_kind)
+        replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
+        replay.finish(np.asarray(result.ready_cnt))
+        self.last_timings = {
+            "encode_s": t_encode,
+            "solve_s": t_solve,
+            "replay_s": _time.perf_counter() - t0,
+        }
+
+    def _resolve_mesh(self, ssn: Session):
+        """Conf-selected device mesh for the solve, or None (single-chip).
+
+        `actionArguments: {xla_allocate: {mesh: ...}}` (env KBT_MESH as
+        the conf-less override): ``off``/``0``/``1`` -> single chip;
+        ``auto`` -> every visible device; an integer -> that many; an
+        explicit ``backend:count`` (e.g. ``cpu:8``) pins the JAX backend
+        — how the driver/tests exercise the multi-chip path on a virtual
+        CPU mesh when the ambient default backend is a single TPU. The
+        mesh size is clamped to the largest power of two available so it
+        always divides the encoder's power-of-two node buckets. The
+        resolved size lands in `self.last_mesh_size` so callers can
+        verify the sharded path actually engaged."""
+        self.last_mesh_size = 1
+        spec = ssn.action_arguments.get(self.name, {}).get(
+            "mesh", os.environ.get("KBT_MESH", "")
+        )
+        spec = (spec or "").strip().lower()
+        if spec in ("", "off", "none", "0", "1"):
+            return None
+        import jax as _jax
+
+        backend = None
+        if ":" in spec:
+            backend, spec = spec.split(":", 1)
+        try:
+            devices = _jax.devices(backend)
+        except RuntimeError:
+            log.warning(
+                "mesh backend %r unavailable; running single-chip", backend
+            )
+            return None
+        if spec == "auto":
+            want = len(devices)
+        else:
+            try:
+                want = int(spec)
+            except ValueError:
+                # A bad conf value must not kill the scheduling loop
+                # (scheduler.py's rule for parse errors applies to
+                # values too) — degrade to single-chip and say so.
+                log.warning(
+                    "unrecognized mesh spec %r; running single-chip", spec
+                )
+                return None
+        if want < 1:
+            log.warning("mesh=%s is not a device count; running single-chip", spec)
+            return None
+        n = min(want, len(devices))
+        n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+        # The encoder buckets the node axis to multiples of 128, which
+        # every pow2 mesh up to 128 divides; a larger mesh would break
+        # the GSPMD divisibility invariant.
+        if n > 128:
+            log.warning(
+                "mesh clamped from %d to 128 devices (node-bucket divisibility)", n
+            )
+            n = 128
+        if n <= 1:
+            if spec != "auto" and want > 1:
+                log.warning(
+                    "mesh=%s requested but only %d device(s) visible; "
+                    "running single-chip",
+                    spec,
+                    len(devices),
+                )
+            return None
+        if n != want and spec != "auto":
+            log.warning("mesh=%s clamped to %d devices (pow2, available)", spec, n)
+        from kube_batch_tpu.parallel import make_mesh
+
+        self.last_mesh_size = n
+        return make_mesh(n, devices=devices[:n])
+
+    def _make_solver(
+        self,
+        arrays,
+        enable_drf: bool,
+        enable_proportion: bool,
+        dtype,
+        mesh=None,
+    ):
+        """Pick the device solve: with a conf-selected multi-chip mesh,
+        the GSPMD node-axis-sharded XLA kernel (parallel.ShardedSolver);
+        single-chip, the fused Pallas kernel on TPU-class backends
+        (float32, in-envelope snapshots), else the XLA `lax.while_loop`
+        kernel. `KBT_PALLAS=0` forces the XLA kernel; `KBT_PALLAS=interpret`
+        runs the Pallas kernel in interpreter mode (CPU parity tests).
+        Live InterPodAffinity scores no longer force the XLA kernel: the
+        Pallas solver re-folds its affinity static whenever the action
+        refreshes arrays["pod_sc"] between pause/resume segments
+        (pallas_solve.fold_affinity_scores)."""
+        from kube_batch_tpu.ops.kernels import solve_allocate_state
+
+        if mesh is not None:
+            from kube_batch_tpu.parallel import ShardedSolver
+
+            solver = None
+            try:
+                solver = ShardedSolver(
+                    arrays, mesh, enable_drf=enable_drf,
+                    enable_proportion=enable_proportion,
+                )
+                log.info(
+                    "solving with node-axis-sharded XLA kernel over a "
+                    "%d-device mesh", mesh.devices.size,
+                )
+            except Exception:
+                log.exception(
+                    "sharded solver init failed; using single-chip path"
+                )
+            if solver is not None:
+                sharded = solver
+
+                def solve_sharded(st):
+                    # First solve still traces/compiles lazily; fall back
+                    # to the single-chip XLA kernel on failure rather
+                    # than losing the cycle.
+                    nonlocal sharded
+                    if sharded is not None:
+                        try:
+                            return sharded.solve(st)
+                        except Exception:
+                            log.exception(
+                                "sharded solve failed; falling back to "
+                                "single-chip XLA kernel"
+                            )
+                            sharded = None
+                    return solve_allocate_state(
+                        arrays, st, enable_drf=enable_drf,
+                        enable_proportion=enable_proportion,
+                    )
+
+                return solve_sharded
+
+        mode = os.environ.get("KBT_PALLAS", "1")
+        solver = None
+        if mode != "0" and dtype == np.float32:
+            import jax as _jax
+
+            from kube_batch_tpu.ops import pallas_solve
+
+            interpret = mode == "interpret"
+            on_tpu = _jax.default_backend() == "tpu"  # Mosaic kernels are TPU-only
+            if (on_tpu or interpret) and pallas_solve.supported(arrays):
+                try:
+                    solver = pallas_solve.PallasSolver(
+                        arrays, enable_drf, enable_proportion, interpret=interpret
+                    )
+                    log.debug("solving with fused pallas kernel")
+                except Exception:
+                    log.exception("pallas solver init failed; using XLA kernel")
+                    solver = None
+
+        def solve_fn(st):
+            # Tracing/Mosaic lowering is lazy — the first solve call can
+            # still fail, so the fallback has to live here, not only at
+            # solver construction. Both solvers speak SolveState, so the
+            # XLA kernel resumes exactly from wherever pallas left off.
+            nonlocal solver
+            if solver is not None:
+                try:
+                    return solver.solve(st)
+                except Exception:
+                    log.exception("pallas solve failed; falling back to XLA kernel")
+                    solver = None
+            return solve_allocate_state(
+                arrays, st, enable_drf=enable_drf, enable_proportion=enable_proportion
+            )
+
+        return solve_fn
+
+    # -- host-side serial step for one pod-affinity task ---------------------
+
+    def _host_step(self, ssn: Session, enc, arrays, replay: "_Replayer", s):
+        """Exactly the serial inner-loop body (allocate.py:90-119 /
+        reference allocate.go:139-185) for the paused task, then patch the
+        solver state: pointer, node vectors, job lifecycle."""
+        from kube_batch_tpu.ops.kernels import KIND_ALLOCATED, KIND_PIPELINED
+        from kube_batch_tpu.plugins.predicates import PredicateError
+        from kube_batch_tpu.utils import (
+            get_node_list,
+            predicate_nodes,
+            prioritize_nodes,
+            select_best_node,
+        )
+
+        row = int(s.paused_at)
+        task = enc.tasks[row]
+        job = ssn.jobs[task.job]
+        jrow = int(s.cur)
+        all_nodes = get_node_list(ssn.nodes)
+
+        def predicate_fn(t, node):
+            if not t.init_resreq.less_equal(node.idle) and not t.init_resreq.less_equal(
+                node.releasing
+            ):
+                raise PredicateError(
+                    f"task <{t.namespace}/{t.name}> ResourceFit failed "
+                    f"on node <{node.name}>"
+                )
+            ssn.predicate_fn(t, node)
+
+        if job.nodes_fit_delta:
+            job.nodes_fit_delta = {}
+
+        s.ptr[jrow] += 1
+        candidates = predicate_nodes(task, all_nodes, predicate_fn)
+        if not candidates:
+            # serial `break`: the job leaves the heap unassigned.
+            log.debug("host step: no candidates for %s; abandoning job", task.uid)
+            s.job_active[jrow] = False
+            return s._replace(cur=np.int32(-1), it=s.it + 1)
+
+        node_scores = prioritize_nodes(
+            task, candidates, ssn.node_order_map_fn, ssn.node_order_reduce_fn
+        )
+        node = select_best_node(node_scores)
+        nrow = replay.node_idx[node.name]
+
+        if task.init_resreq.less_equal(node.idle):
+            kind = KIND_ALLOCATED
+        else:
+            delta = node.idle.clone()
+            delta.fit_delta(task.init_resreq)
+            job.nodes_fit_delta[node.name] = delta
+            kind = KIND_PIPELINED if task.init_resreq.less_equal(node.releasing) else 0
+
+        cur = jrow
+        if kind:
+            try:
+                replay.apply_immediate(row, nrow, kind, int(s.step))
+            except Exception as e:  # noqa: BLE001
+                # Volume assume failed (the first mutation apply_one makes,
+                # so session state is untouched): serial semantics — the
+                # task is consumed unassigned and the loop moves on
+                # (allocate.go:158-161 logs and continues).
+                log.error(
+                    "host step: failed to allocate task %s on %s: %s",
+                    task.uid, node.name, e,
+                )
+                return s._replace(cur=np.int32(cur), it=s.it + np.int32(1))
+            res = np.asarray(arrays["task_res"][row], s.idle.dtype)
+            s.used[nrow] += res
+            if kind == KIND_ALLOCATED:
+                s.idle[nrow] -= res
+                s.ready_cnt[jrow] += 1
+            else:
+                s.rel[nrow] -= res
+            s.ntasks[nrow] += 1
+            s.nports[nrow] |= arrays["task_ports"][row]
+            s.assigned_node[row] = nrow
+            s.assigned_kind[row] = kind
+            s.assign_pos[row] = int(s.step)
+            if replay.drf is not None:
+                s.job_alloc[jrow] += res
+            qrow = int(arrays["job_queue"][jrow])
+            if replay.prop is not None:
+                s.q_alloc[qrow] += res
+                s.q_alloc_has_sc[qrow] |= bool(arrays["task_res_has_sc"][row])
+            s = s._replace(step=s.step + np.int32(1))
+            if int(s.ready_cnt[jrow]) >= int(arrays["job_min"][jrow]):
+                cur = -1
+        return s._replace(cur=np.int32(cur), it=s.it + np.int32(1))
+
+    @staticmethod
+    def _fallback(ssn: Session) -> None:
+        from kube_batch_tpu.actions.allocate import AllocateAction
+
+        AllocateAction().execute(ssn)
+
+
+class _Replayer:
+    """Applies kernel assignments to the session in bulk — the exact net
+    state mutations of `ssn.allocate`/`ssn.pipeline` (session.go:198-296)
+    without per-task Python session machinery:
+
+    - task status index surgery + `job.allocated` growth (job_info.go:233-259);
+    - node task map + idle/releasing/used accounting aggregated per node
+      (node_info.go:108-136) — exact because milli-CPU/byte quantities are
+      integers, so float addition order cannot change the sums; scalar-map
+      key presence follows the same add/sub rules as the sequential path;
+    - drf/proportion allocated vectors advanced per event in kernel order
+      with one final share recompute (the intermediate shares the serial
+      event handlers maintain are never read between events);
+    - the gang dispatch barrier at `finish`: jobs whose final ready count
+      clears min_available get every Allocated task dispatched —
+      BindVolumes + cache.Bind + Binding status, exactly the set the
+      serial flip-time dispatches produce (session.go:285-322).
+    """
+
+    def __init__(self, ssn: Session, enc, arrays, enable_drf: bool, enable_prop: bool) -> None:
+        self.ssn = ssn
+        self.enc = enc
+        self.arrays = arrays
+        self.task_res64 = np.asarray(arrays["task_res"], np.float64)
+        self.task_job = np.asarray(arrays["task_job"])
+        self.task_res_has_sc = np.asarray(arrays["task_res_has_sc"])
+        self.job_queue = np.asarray(arrays["job_queue"])
+        self.drf = ssn.plugins.get("drf") if enable_drf else None
+        self.prop = ssn.plugins.get("proportion") if enable_prop else None
+        self.node_idx = {name: i for i, name in enumerate(enc.node_names)}
+        # Row-indexed hot lookups for the bulk loop.
+        self.task_keys = [f"{t.namespace}/{t.name}" for t in enc.tasks]
+        self.node_by_row = [ssn.nodes[name] for name in enc.node_names]
+        self.node_tasks_by_row = [n.tasks for n in self.node_by_row]
+        self.replayed = 0  # assignment events already applied
+        self.alloc_jobs: set[str] = set()  # jobs with >=1 Allocated event
+        # jobs that took a host-stepped (apply_immediate) event: their
+        # allocated tasks may carry volume claims / binder-managed
+        # volume_ready, so finish() keeps the per-task checks for them
+        self.stepped_jobs: set[str] = set()
+        # per-node aggregation buffers (flushed once per segment)
+        self._node_buf: dict[int, _NodeDelta] = {}
+        self._touched_drf: set[str] = set()
+        self._touched_prop: set[str] = set()
+
+    # -- one event -----------------------------------------------------------
+
+    def apply_one(self, row: int, nrow: int, kind: int) -> None:
+        from kube_batch_tpu.ops.kernels import KIND_ALLOCATED
+
+        ssn = self.ssn
+        task = self.enc.tasks[row]
+        job = ssn.jobs[task.job]
+        hostname = self.enc.node_names[nrow]
+        status = TaskStatus.ALLOCATED if kind == KIND_ALLOCATED else TaskStatus.PIPELINED
+
+        if kind == KIND_ALLOCATED:
+            ssn.cache.allocate_volumes(task, hostname)
+            self.alloc_jobs.add(job.uid)
+        self.stepped_jobs.add(job.uid)
+
+        # status index surgery == update_task_status's net effect
+        pend = job.task_status_index.get(TaskStatus.PENDING)
+        if pend is not None:
+            pend.pop(task.uid, None)
+            if not pend:
+                del job.task_status_index[TaskStatus.PENDING]
+        task.status = status
+        task.node_name = hostname
+        job.task_status_index.setdefault(status, {})[task.uid] = task
+        if kind == KIND_ALLOCATED:
+            job.allocated.add(task.resreq)
+
+        # node: task map entry (a clone, node_info.go:117) + deferred sums
+        node = ssn.nodes[hostname]
+        node.tasks[self.task_keys[row]] = task.clone_for_residency()
+        buf = self._node_buf.get(nrow)
+        if buf is None:
+            buf = self._node_buf[nrow] = _NodeDelta()
+        res64 = self.task_res64[row]
+        if kind == KIND_ALLOCATED:
+            buf.alloc += res64
+        else:
+            buf.pipe += res64
+        if task.resreq.scalars:
+            buf.scalar_keys.update(task.resreq.scalars)
+
+        # drf / proportion event handlers (drf.go:135-154, proportion.go:202-223)
+        if self.drf is not None:
+            self.drf.job_attrs[job.uid].allocated.add(task.resreq)
+            self._touched_drf.add(job.uid)
+        if self.prop is not None:
+            self.prop.queue_attrs[job.queue].allocated.add(task.resreq)
+            self._touched_prop.add(job.queue)
+
+    # -- a segment -----------------------------------------------------------
+
+    def apply_immediate(self, row: int, nrow: int, kind: int, pos: int) -> None:
+        """One host-stepped event, applied and flushed right away (the next
+        host step's predicates need the node state current)."""
+        self.apply_one(row, nrow, kind)
+        self.replayed = pos + 1
+        self._flush_nodes()
+        # Invalidate state_seq-keyed score memos (nodeorder/tensorscore):
+        # the replay mutates node accounting without going through
+        # ssn.allocate/pipeline, which are what normally bump the seq.
+        self.ssn.state_seq += 1
+
+    def apply_upto(self, assign_pos, assigned_node, assigned_kind, step: int) -> None:
+        """Apply all events with replayed <= pos < step — the same net
+        state mutations as per-event `apply_one`, but with every
+        order-independent aggregate (node idle/releasing/used, job
+        allocated, drf/proportion vectors) computed as a vectorized
+        segment sum. Exact: all quantities are integer-grid float64, so
+        addition order cannot change the sums, and scalar-map key
+        creation follows the same per-event add/sub rules via the
+        tracked key sets."""
+        from kube_batch_tpu.ops.kernels import KIND_ALLOCATED
+
+        if step <= self.replayed:
+            return
+        sel = (assign_pos >= self.replayed) & (assign_pos < step)
+        rows = np.nonzero(sel)[0]
+        self.replayed = step
+        if rows.size == 0:
+            return
+        # Same memo invalidation as apply_immediate: bulk replay mutates
+        # node.used/tasks behind the session's back.
+        self.ssn.state_seq += 1
+        rows = rows[np.argsort(assign_pos[rows], kind="stable")]
+        nrows = assigned_node[rows]
+        kinds = assigned_kind[rows]
+        alloc = kinds == KIND_ALLOCATED
+        res = self.task_res64[rows]
+        tjob = self.task_job[rows]
+        scalar_names = self.enc.scalar_names
+        R = res.shape[1]
+        empty: frozenset = frozenset()
+
+        # -- scalar-key bookkeeping (only rows whose resreq has scalars) --
+        nkeys_alloc: dict[int, set] = {}
+        nkeys_pipe: dict[int, set] = {}
+        jkeys_alloc: dict[int, set] = {}
+        jkeys_all: dict[int, set] = {}
+        qkeys: dict[int, set] = {}
+        for i in np.nonzero(self.task_res_has_sc[rows])[0].tolist():
+            keys = self.enc.tasks[int(rows[i])].resreq.scalars.keys()
+            n_i, j_i = int(nrows[i]), int(tjob[i])
+            (nkeys_alloc if alloc[i] else nkeys_pipe).setdefault(n_i, set()).update(keys)
+            if alloc[i]:
+                jkeys_alloc.setdefault(j_i, set()).update(keys)
+            jkeys_all.setdefault(j_i, set()).update(keys)
+            qkeys.setdefault(int(self.job_queue[j_i]), set()).update(keys)
+
+        # -- node accounting (node_info.go:108-136 net effect) ------------
+        touched_n = np.unique(nrows)
+        compn = np.searchsorted(touched_n, nrows)
+        n_alloc_vec = _segment_sum(compn[alloc], res[alloc], touched_n.size, R)
+        n_pipe_vec = _segment_sum(compn[~alloc], res[~alloc], touched_n.size, R)
+        for k, nrow in enumerate(touched_n.tolist()):
+            node = self.node_by_row[nrow]
+            ka = nkeys_alloc.get(nrow, empty)
+            kp = nkeys_pipe.get(nrow, empty)
+            _res_sub(node.idle, n_alloc_vec[k], scalar_names, ka)
+            _res_sub(node.releasing, n_pipe_vec[k], scalar_names, kp)
+            _res_add(node.used, n_alloc_vec[k] + n_pipe_vec[k], scalar_names, ka | kp)
+
+        # -- job.allocated + drf/proportion event bookkeeping -------------
+        touched_j = np.unique(tjob)
+        compj = np.searchsorted(touched_j, tjob)
+        j_tot = _segment_sum(compj, res, touched_j.size, R)
+        j_alloc = _segment_sum(compj[alloc], res[alloc], touched_j.size, R)
+        jobs_with_alloc = set(np.unique(tjob[alloc]).tolist())
+        drf = self.drf
+        for k, jrow in enumerate(touched_j.tolist()):
+            job = self.enc.jobs[jrow]
+            if jrow in jobs_with_alloc:
+                self.alloc_jobs.add(job.uid)
+                _res_add(job.allocated, j_alloc[k], scalar_names, jkeys_alloc.get(jrow, empty))
+            if drf is not None:
+                _res_add(
+                    drf.job_attrs[job.uid].allocated, j_tot[k], scalar_names,
+                    jkeys_all.get(jrow, empty),
+                )
+                self._touched_drf.add(job.uid)
+        prop = self.prop
+        if prop is not None:
+            qrow_arr = self.job_queue[tjob]
+            touched_q = np.unique(qrow_arr)
+            compq = np.searchsorted(touched_q, qrow_arr)
+            q_tot = _segment_sum(compq, res, touched_q.size, R)
+            for k, qrow in enumerate(touched_q.tolist()):
+                qname = self.enc.queues[qrow].name
+                _res_add(
+                    prop.queue_attrs[qname].allocated, q_tot[k], scalar_names,
+                    qkeys.get(qrow, empty),
+                )
+                self._touched_prop.add(qname)
+
+        # -- per-task surgery (status index, node task map, volumes) ------
+        # Rows grouped per job (stable sort preserves assign order within
+        # a job, which is what fixes sidx insertion order and therefore
+        # dispatch/bind order); the status-index moves then land as one
+        # C-level dict.update per (job, status) instead of per-task
+        # get/setdefault (VERDICT r3 item 8, the replay diet). The
+        # per-event body itself — status flip, node_name set, residency
+        # clone, node task-map insert — runs in the native module when
+        # built (kube_batch_tpu/native, round-4 replay diet), with the
+        # Python loop as fallback and for volume-carrying rows.
+        jobs_l = self.enc.jobs
+        ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
+        order = np.argsort(compj, kind="stable")
+        counts = np.bincount(compj, minlength=touched_j.size).tolist()
+        rows_o = rows[order].tolist()
+        nrows_o = nrows[order].tolist()
+        segments = None
+        if _native is not None:
+            try:
+                segments = _native.bulk_assign(
+                    self.enc.tasks,
+                    self.task_keys,
+                    self.node_tasks_by_row,
+                    self.enc.node_names,
+                    rows_o,
+                    nrows_o,
+                    alloc[order].astype(np.uint8).tobytes(),
+                    counts,
+                    ALLOCATED,
+                    PIPELINED,
+                )
+            except (ValueError, TypeError, AttributeError):
+                # ValueError: a bulk row carries volume claims (custom
+                # encoder/binder). TypeError/AttributeError: a TaskInfo
+                # variant without the expected plain member slots. Either
+                # way the prepass mutated nothing — take the Python path,
+                # which routes volumes through cache.allocate_volumes and
+                # handles any attribute layout.
+                segments = None
+        if segments is None:
+            segments = self._assign_segments_py(
+                rows_o, nrows_o, alloc[order].tolist(), counts
+            )
+        for k, jrow in enumerate(touched_j.tolist()):
+            alloc_d, pipe_d = segments[k]
+            sidx = jobs_l[jrow].task_status_index
+            pend = sidx.get(TaskStatus.PENDING)
+            if pend is not None:
+                for uid in alloc_d:
+                    pend.pop(uid, None)
+                for uid in pipe_d:
+                    pend.pop(uid, None)
+                if not pend:
+                    del sidx[TaskStatus.PENDING]
+            if alloc_d:
+                d = sidx.get(ALLOCATED)
+                if d is None:
+                    sidx[ALLOCATED] = alloc_d
+                else:
+                    d.update(alloc_d)
+            if pipe_d:
+                d = sidx.get(PIPELINED)
+                if d is None:
+                    sidx[PIPELINED] = pipe_d
+                else:
+                    d.update(pipe_d)
+
+    def _assign_segments_py(self, rows_o, nrows_o, alloc_o, counts):
+        """Pure-Python twin of native.bulk_assign: per-event status flip,
+        node_name set, residency clone, node task-map insert; returns one
+        (alloc_d, pipe_d) pair per job segment."""
+        tasks = self.enc.tasks
+        tkeys = self.task_keys
+        node_by_row = self.node_by_row
+        alloc_volumes = self.ssn.cache.allocate_volumes
+        ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
+        segments = []
+        pos = 0
+        for cnt in counts:
+            end = pos + cnt
+            alloc_d: dict = {}
+            pipe_d: dict = {}
+            for row, nrow_i, is_alloc in zip(
+                rows_o[pos:end], nrows_o[pos:end], alloc_o[pos:end]
+            ):
+                task = tasks[row]
+                node = node_by_row[nrow_i]
+                if is_alloc:
+                    if task.pod.volumes:
+                        # bulk rows cannot carry claims (encode routes
+                        # volume pods host_only) — guard kept for custom
+                        # encoders/binders; the job keeps finish()'s
+                        # per-task volume checks
+                        alloc_volumes(task, node.name)
+                        self.stepped_jobs.add(task.job)
+                    else:
+                        task.volume_ready = True
+                    task.status = ALLOCATED
+                    alloc_d[task.uid] = task
+                else:
+                    task.status = PIPELINED
+                    pipe_d[task.uid] = task
+                task.node_name = node.name
+                node.tasks[tkeys[row]] = task.clone_for_residency()
+            pos = end
+            segments.append((alloc_d, pipe_d))
+        return segments
+
+    def _flush_nodes(self) -> None:
+        """Fold the per-node resource deltas into NodeInfo, following
+        Resource.add/sub scalar-map key rules (resource_info.go:146-166)."""
+        scalar_names = self.enc.scalar_names
+        for nrow, buf in self._node_buf.items():
+            node = self.ssn.nodes[self.enc.node_names[nrow]]
+            total = buf.alloc + buf.pipe
+            _res_sub(node.idle, buf.alloc, scalar_names, buf.scalar_keys)
+            _res_sub(node.releasing, buf.pipe, scalar_names, buf.scalar_keys)
+            _res_add(node.used, total, scalar_names, buf.scalar_keys)
+        self._node_buf = {}
+
+    # -- end of action -------------------------------------------------------
+
+    def finish(self, ready_cnt) -> None:
+        """Final share sync + the gang dispatch barrier."""
+        from kube_batch_tpu import metrics
+
+        ssn = self.ssn
+        if self.drf is not None:
+            for uid in self._touched_drf:
+                attr = self.drf.job_attrs[uid]
+                self.drf._update_share(attr)
+        if self.prop is not None:
+            for qname in self._touched_prop:
+                attr = self.prop.queue_attrs[qname]
+                self.prop._update_share(attr)
+
+        import time as _time
+
+        now = _time.time()
+        job_min = self.arrays["job_min"]
+        bind_volumes = ssn.cache.bind_volumes
+        BINDING = TaskStatus.BINDING
+        to_bind: list = []  # dispatched tasks, in dispatch order
+        for i, job in enumerate(self.enc.jobs):
+            if job.uid not in self.alloc_jobs:
+                continue
+            if int(ready_cnt[i]) < int(job_min[i]):
+                continue
+            allocated = job.task_status_index.get(TaskStatus.ALLOCATED)
+            if not allocated:
+                continue
+            if job.uid not in self.stepped_jobs:
+                # Pure-bulk gang: every task came through bulk_assign, so
+                # it is volume-less with volume_ready=True — no per-task
+                # checks, one bulk status flip, one bulk index move.
+                dispatched = list(allocated.values())
+                if _native is not None:
+                    _native.bulk_set_slot(dispatched, "status", BINDING)
+                else:
+                    for task in dispatched:
+                        task.status = BINDING
+                to_bind.extend(dispatched)
+                binding = job.task_status_index.setdefault(BINDING, {})
+                binding.update(allocated)
+                job.task_status_index.pop(TaskStatus.ALLOCATED, None)
+                log.debug(
+                    "dispatched gang job %s (%d tasks)", job.uid, int(ready_cnt[i])
+                )
+                continue
+            dispatched = []
+            failed = False
+            for task in allocated.values():
+                if task.pod.volumes or not task.volume_ready:
+                    try:
+                        bind_volumes(task)
+                    except Exception as e:  # noqa: BLE001
+                        # Same routing as session._dispatch: errTasks
+                        # resync + stop dispatching this gang (the serial
+                        # path's early return, session.go:285-295).
+                        log.error("failed to bind volumes of %s: %s", task.uid, e)
+                        resync = getattr(ssn.cache, "resync_task", None)
+                        if resync is not None:
+                            resync(task)
+                        failed = True
+                        break
+                task.status = BINDING
+                dispatched.append(task)
+                to_bind.append(task)
+            # status-index move as one bulk update instead of per-task
+            # pop/insert; on a volume failure only the dispatched prefix
+            # moves (the rest stay Allocated, exactly like the serial
+            # early return).
+            binding = job.task_status_index.setdefault(BINDING, {})
+            if not failed:
+                binding.update(allocated)
+                job.task_status_index.pop(TaskStatus.ALLOCATED, None)
+            else:
+                for task in dispatched:
+                    allocated.pop(task.uid, None)
+                    binding[task.uid] = task
+            log.debug("dispatched gang job %s (%d tasks)", job.uid, int(ready_cnt[i]))
+        # Bulk bind: one cache mutex acquisition + one async write batch
+        # for the whole action's dispatches (the replay-diet half of
+        # VERDICT r3 item 8 — per-task cache.bind was the replay's
+        # single largest cost at 50k).
+        bind_many = getattr(ssn.cache, "bind_many", None)
+        if bind_many is not None:
+            bind_many([(t, t.node_name) for t in to_bind])
+        else:
+            for t in to_bind:
+                ssn.cache.bind(t, t.node_name)
+        if to_bind:
+            # e2e scheduling latency per dispatched pod, as one vector op
+            # instead of a 50k-iteration max() loop
+            created = np.fromiter(
+                (t.pod.metadata.creation_timestamp for t in to_bind),
+                np.float64,
+                count=len(to_bind),
+            )
+            metrics.update_task_schedule_durations(
+                np.maximum(0.0, now - created)
+            )
+
+
+def _segment_sum(seg_ids, vecs, n_segments: int, R: int) -> np.ndarray:
+    """[n_segments, R] column-wise weighted bincount — the net effect of
+    `np.add.at(out, seg_ids, vecs)` but ~10x faster (ufunc.at is a
+    scalar scatter loop; bincount is one C pass per column). Exact:
+    integer-grid float64 sums are order-independent."""
+    out = np.zeros((n_segments, R))
+    if seg_ids.size == 0 or n_segments == 0:
+        return out
+    for r in range(R):
+        out[:, r] = np.bincount(seg_ids, weights=vecs[:, r], minlength=n_segments)
+    return out
+
+
+class _NodeDelta:
+    __slots__ = ("alloc", "pipe", "scalar_keys")
+
+    def __init__(self) -> None:
+        self.alloc = 0.0  # np broadcasts to [R] on first +=
+        self.pipe = 0.0
+        self.scalar_keys: set[str] = set()
+
+
+def _res_sub(res, vec, scalar_names, keys) -> None:
+    """Resource -= vec with the Go nil-map branch: scalar entries change
+    only when the receiver already tracks scalars (resource_info.go:151-153)."""
+    if np.ndim(vec) == 0:  # this pool saw no assignments
+        return
+    res.milli_cpu -= float(vec[0])
+    res.memory -= float(vec[1])
+    if res.scalars and keys:
+        for k in keys:
+            res.scalars[k] = res.scalars.get(k, 0.0) - float(vec[2 + scalar_names.index(k)])
+
+
+def _res_add(res, vec, scalar_names, keys) -> None:
+    if np.ndim(vec) == 0:
+        return
+    res.milli_cpu += float(vec[0])
+    res.memory += float(vec[1])
+    for k in keys:
+        res.scalars[k] = res.scalars.get(k, 0.0) + float(vec[2 + scalar_names.index(k)])
+
+
+def new() -> Action:
+    return XlaAllocateAction()

@@ -1,0 +1,83 @@
+"""xla_preempt action: preempt with a vectorized candidate-node scan.
+
+The serial preempt action's hot loop is the same per-task node scan as
+allocate's (reference pkg/scheduler/actions/preempt/preempt.go:176-256:
+`util.PredicateNodes` + `util.PrioritizeNodes` over every node for every
+starved preemptor task, 16-goroutine fan-out in Go). This action keeps
+the reference's control flow — queue-by-queue preemptor heaps, Statement
+speculation with commit/discard, victim selection by task order
+(preempt.go:81-170) — entirely host-side, and replaces only the
+per-preemptor node scan with one vectorized pass over the encoder's
+(task-group x node-group) predicate matrices and the nodeorder score
+formulas.
+
+Design note (SURVEY.md section 7(b)): unlike the allocate solve — a
+>50k-iteration sequential loop that lives on-device as a fused Pallas
+kernel (ops/pallas_solve.py) — the preempt scan is one O(N x R) data-
+parallel pass per preemptor with Statement mutations between scans. At
+cluster sizes (N <= 100k nodes) that pass is microseconds of SIMD work,
+far below a single host<->device round-trip, so it runs as float64 numpy:
+bit-identical to the serial float64 oracle (including score tie-breaks),
+which keeps `xla_preempt ≡ preempt` exact rather than
+float32-approximate. The matrices it reads are the same ones the device
+path consumes (ops/encode.py).
+
+Scan-visible dynamic state: a Statement changes node residency only
+through `pipeline` (evict flips a resident Running->Releasing, which
+changes neither pod count, ports, nor Used — node_info.go:168-174), so
+the mirror updates on pipeline/unpipeline alone; `_ScanStatement` keeps
+it in sync through discard rollbacks.
+
+Tasks whose pod spec carries required pod (anti-)affinity are pairwise-
+dynamic (predicates.go:187-199) and scan serially, exactly like the
+allocate hybrid routes them host-side.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.actions.scan import ScanStatement, VectorScan
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import Session
+
+
+class XlaPreemptAction(Action):
+    """Drop-in replacement for the serial preempt action (conf
+    ``actions: "...,xla_preempt,..."``): the shared run_preempt driver
+    (actions/preempt.py) with the vectorized node scan and the
+    mirror-syncing Statement."""
+
+    @property
+    def name(self) -> str:
+        return "xla_preempt"
+
+    def execute(self, ssn: Session) -> None:
+        from kube_batch_tpu.actions.envelope import scan_supported
+        from kube_batch_tpu.actions.preempt import PreemptAction, run_preempt, serial_candidates
+
+        if not scan_supported(ssn):
+            # VectorScan hardcodes the built-in predicate set and the
+            # nodeorder/tensorscore score model; an unmodeled plugin in
+            # the conf would silently diverge from the serial oracle.
+            PreemptAction().execute(ssn)
+            return
+
+        scan = VectorScan(ssn)
+
+        def candidates(s: Session, preemptor: TaskInfo):
+            selected = scan.candidates(preemptor)
+            if selected is None:
+                # host-only task (required pod affinity / scan disabled):
+                # the serial predicate walk, allocate-hybrid twin
+                return serial_candidates(s, preemptor)
+            return selected
+
+        run_preempt(
+            ssn,
+            statement_factory=lambda s: ScanStatement(s, scan),
+            candidates_fn=candidates,
+        )
+
+
+def new() -> Action:
+    return XlaPreemptAction()

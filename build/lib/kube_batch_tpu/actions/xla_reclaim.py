@@ -1,0 +1,51 @@
+"""xla_reclaim action: reclaim with the vectorized predicate scan.
+
+The serial reclaim walks every node per starved task, running the full
+predicate chain inline (reference reclaim.go:113-128 — the same hot loop
+shape as preempt's, minus scoring: first feasible node with enough
+cross-queue victims wins, in node order). This action reuses the shared
+`run_reclaim` driver (actions/reclaim.py) with `VectorScan.feasible` —
+one numpy pass over the encoder's dedup'd predicate matrices per task —
+and keeps victim vetting (Reclaimable), direct evicts, and the pipeline
+exactly serial.
+
+Evicts flip residents Running->Releasing (no scan-visible change);
+pipelines update the scan mirrors through the on_pipeline hook. Host-only
+tasks and out-of-envelope snapshots walk serially per task.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.actions.scan import VectorScan
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import Session
+
+
+class XlaReclaimAction(Action):
+    @property
+    def name(self) -> str:
+        return "xla_reclaim"
+
+    def execute(self, ssn: Session) -> None:
+        from kube_batch_tpu.actions.envelope import scan_supported
+        from kube_batch_tpu.actions.reclaim import ReclaimAction, run_reclaim, serial_feasible
+
+        if not scan_supported(ssn):
+            # Same envelope rule as xla_preempt: unmodeled predicate or
+            # node-order plugins fall back to the serial action.
+            ReclaimAction().execute(ssn)
+            return
+
+        scan = VectorScan(ssn)
+
+        def feasible(s: Session, task):
+            nodes = scan.feasible(task)
+            if nodes is None:
+                return serial_feasible(s, task)
+            return nodes
+
+        run_reclaim(ssn, feasible_fn=feasible, on_pipeline=scan.on_pipeline)
+
+
+def new() -> Action:
+    return XlaReclaimAction()

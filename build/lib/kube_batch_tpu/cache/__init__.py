@@ -1,0 +1,43 @@
+"""L2: the event-driven cluster cache (reference pkg/scheduler/cache)."""
+
+from kube_batch_tpu.cache.cache import (
+    NoopVolumeBinder,
+    SchedulerCache,
+    StoreBinder,
+    StoreEvictor,
+    StoreStatusUpdater,
+    create_shadow_pod_group,
+    job_terminated,
+    shadow_pod_group,
+)
+from kube_batch_tpu.cache.store import (
+    KINDS,
+    NODES,
+    PDBS,
+    POD_GROUPS,
+    PODS,
+    PRIORITY_CLASSES,
+    QUEUES,
+    ClusterStore,
+    EventHandler,
+)
+
+__all__ = [
+    "ClusterStore",
+    "EventHandler",
+    "KINDS",
+    "NODES",
+    "NoopVolumeBinder",
+    "PDBS",
+    "POD_GROUPS",
+    "PODS",
+    "PRIORITY_CLASSES",
+    "QUEUES",
+    "SchedulerCache",
+    "StoreBinder",
+    "StoreEvictor",
+    "StoreStatusUpdater",
+    "create_shadow_pod_group",
+    "job_terminated",
+    "shadow_pod_group",
+]

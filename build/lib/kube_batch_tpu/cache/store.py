@@ -1,0 +1,284 @@
+"""In-process cluster store: the API-server + informer substitute.
+
+The reference's cache subscribes nine client-go informers to the API
+server (cache/cache.go:233-301) and receives add/update/delete callbacks
+as the watch stream delivers deltas. TPU-native kube-batch runs against
+an in-process object store instead: callers (tests, the simulator, a
+future external bridge) mutate the store through k8s-shaped CRUD calls,
+and the store dispatches the same add/update/delete callbacks to every
+registered handler — including an initial-list replay on registration,
+which is what makes ``has_synced`` true (the WaitForCacheSync
+equivalent, cache/cache.go:327-348).
+
+Event dispatch is synchronous in the mutating caller's thread, ordered
+per object, outside the store lock (so a handler may re-enter the
+store). That preserves the informer contract the cache depends on —
+events for one object arrive in order — without a background pump
+thread per kind.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from kube_batch_tpu import log
+from kube_batch_tpu.apis.types import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+    PriorityClass,
+    Queue,
+    StorageClass,
+)
+
+PODS = "pods"
+NODES = "nodes"
+POD_GROUPS = "podgroups"
+QUEUES = "queues"
+PDBS = "poddisruptionbudgets"
+PRIORITY_CLASSES = "priorityclasses"
+PVS = "persistentvolumes"
+PVCS = "persistentvolumeclaims"
+STORAGE_CLASSES = "storageclasses"
+
+KINDS = (
+    PODS, NODES, POD_GROUPS, QUEUES, PDBS, PRIORITY_CLASSES,
+    PVS, PVCS, STORAGE_CLASSES,
+)
+
+# Kinds whose objects are cluster-scoped (keyed by name, not ns/name).
+_CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES, PVS, STORAGE_CLASSES}
+
+
+class AlreadyExists(KeyError):
+    """create() of a key already present — typed so API layers can map
+    it to HTTP 409 without string-matching the message."""
+
+
+def obj_key(kind: str, obj: Any) -> str:
+    meta = obj.metadata
+    if kind in _CLUSTER_SCOPED:
+        return meta.name
+    return f"{meta.namespace}/{meta.name}"
+
+
+@dataclass
+class EventHandler:
+    """One informer subscription (client-go ResourceEventHandlerFuncs +
+    the optional FilterFunc of FilteringResourceEventHandler)."""
+
+    on_add: Optional[Callable[[Any], None]] = None
+    on_update: Optional[Callable[[Any, Any], None]] = None
+    on_delete: Optional[Callable[[Any], None]] = None
+    filter: Optional[Callable[[Any], bool]] = None
+
+    def _passes(self, obj: Any) -> bool:
+        return self.filter is None or self.filter(obj)
+
+    def add(self, obj: Any) -> None:
+        if self.on_add and self._passes(obj):
+            self.on_add(obj)
+
+    def update(self, old: Any, new: Any) -> None:
+        # client-go FilteringResourceEventHandler semantics: an update
+        # whose old object was filtered out is delivered as an Add, and
+        # one whose new object is filtered out as a Delete.
+        old_ok, new_ok = self._passes(old), self._passes(new)
+        if old_ok and new_ok:
+            if self.on_update:
+                self.on_update(old, new)
+        elif new_ok:
+            if self.on_add:
+                self.on_add(new)
+        elif old_ok:
+            if self.on_delete:
+                self.on_delete(old)
+
+    def delete(self, obj: Any) -> None:
+        if self.on_delete and self._passes(obj):
+            self.on_delete(obj)
+
+
+@dataclass
+class _KindStore:
+    objects: dict[str, Any] = field(default_factory=dict)
+    handlers: list[EventHandler] = field(default_factory=list)
+
+
+class ClusterStore:
+    """Thread-safe object store with informer-style event fan-out."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._kinds: dict[str, _KindStore] = {k: _KindStore() for k in KINDS}
+        # Events are appended under _lock (atomically with the mutation)
+        # and drained FIFO under _dispatch_lock, so handlers observe
+        # every event exactly once, in mutation order, even under
+        # concurrent writers — the informer delivery contract. The
+        # dispatch lock is re-entrant: a handler may mutate the store,
+        # and the nested event is delivered inline.
+        self._dispatch_lock = threading.RLock()
+        self._events: deque = deque()  # (verb, handlers, old, new)
+
+    # -- event pump --------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._dispatch_lock:
+                with self._lock:
+                    if not self._events:
+                        return
+                    verb, handlers, old, new = self._events.popleft()
+                for h in handlers:
+                    if verb == "add":
+                        h.add(new)
+                    elif verb == "update":
+                        h.update(old, new)
+                    else:
+                        h.delete(old)
+
+    # -- subscription ------------------------------------------------------
+
+    def add_event_handler(self, kind: str, handler: EventHandler) -> None:
+        """Register + initial-list replay (informer.AddEventHandler).
+        Registration and replay enqueue atomically with respect to
+        concurrent mutations, so the handler sees each object exactly
+        once — either via replay or via the mutation's own event."""
+        with self._lock:
+            ks = self._kinds[kind]
+            ks.handlers.append(handler)
+            for obj in ks.objects.values():
+                self._events.append(("add", [handler], None, obj))
+        self._drain()
+
+    # -- CRUD --------------------------------------------------------------
+
+    def _ks(self, kind: str) -> _KindStore:
+        ks = self._kinds.get(kind)
+        if ks is None:
+            raise KeyError(f"unknown kind {kind!r}")
+        return ks
+
+    def create(self, kind: str, obj: Any) -> Any:
+        key = obj_key(kind, obj)
+        with self._lock:
+            ks = self._ks(kind)
+            if key in ks.objects:
+                raise AlreadyExists(f"{kind} {key!r} already exists")
+            ks.objects[key] = obj
+            self._events.append(("add", list(ks.handlers), None, obj))
+        log.V(4).infof("store: created %s %s", kind, key)
+        self._drain()
+        return obj
+
+    def update(self, kind: str, obj: Any) -> Any:
+        key = obj_key(kind, obj)
+        with self._lock:
+            ks = self._ks(kind)
+            old = ks.objects.get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            ks.objects[key] = obj
+            self._events.append(("update", list(ks.handlers), old, obj))
+        log.V(4).infof("store: updated %s %s", kind, key)
+        self._drain()
+        return obj
+
+    def delete(self, kind: str, key: str) -> Any:
+        with self._lock:
+            ks = self._ks(kind)
+            obj = ks.objects.pop(key, None)
+            if obj is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            self._events.append(("delete", list(ks.handlers), obj, None))
+        log.V(4).infof("store: deleted %s %s", kind, key)
+        self._drain()
+        return obj
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._ks(kind).objects.get(key)
+
+    def list(self, kind: str) -> list[Any]:
+        with self._lock:
+            return list(self._ks(kind).objects.values())
+
+    # -- typed conveniences (what tests and the simulator use) -------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        return self.create(PODS, pod)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return self.update(PODS, pod)
+
+    def delete_pod(self, namespace: str, name: str) -> Pod:
+        return self.delete(PODS, f"{namespace}/{name}")
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self.get(PODS, f"{namespace}/{name}")
+
+    def create_node(self, node: Node) -> Node:
+        return self.create(NODES, node)
+
+    def update_node(self, node: Node) -> Node:
+        return self.update(NODES, node)
+
+    def delete_node(self, name: str) -> Node:
+        return self.delete(NODES, name)
+
+    def create_pod_group(self, pg: PodGroup) -> PodGroup:
+        return self.create(POD_GROUPS, pg)
+
+    def update_pod_group(self, pg: PodGroup) -> PodGroup:
+        return self.update(POD_GROUPS, pg)
+
+    def delete_pod_group(self, namespace: str, name: str) -> PodGroup:
+        return self.delete(POD_GROUPS, f"{namespace}/{name}")
+
+    def create_queue(self, q: Queue) -> Queue:
+        return self.create(QUEUES, q)
+
+    def delete_queue(self, name: str) -> Queue:
+        return self.delete(QUEUES, name)
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        return self.create(PDBS, pdb)
+
+    def create_priority_class(self, pc: PriorityClass) -> PriorityClass:
+        return self.create(PRIORITY_CLASSES, pc)
+
+    def delete_priority_class(self, name: str) -> PriorityClass:
+        return self.delete(PRIORITY_CLASSES, name)
+
+    def create_persistent_volume(self, pv: PersistentVolume) -> PersistentVolume:
+        return self.create(PVS, pv)
+
+    def update_persistent_volume(self, pv: PersistentVolume) -> PersistentVolume:
+        return self.update(PVS, pv)
+
+    def delete_persistent_volume(self, name: str) -> PersistentVolume:
+        return self.delete(PVS, name)
+
+    def create_persistent_volume_claim(
+        self, pvc: PersistentVolumeClaim
+    ) -> PersistentVolumeClaim:
+        return self.create(PVCS, pvc)
+
+    def update_persistent_volume_claim(
+        self, pvc: PersistentVolumeClaim
+    ) -> PersistentVolumeClaim:
+        return self.update(PVCS, pvc)
+
+    def delete_persistent_volume_claim(
+        self, namespace: str, name: str
+    ) -> PersistentVolumeClaim:
+        return self.delete(PVCS, f"{namespace}/{name}")
+
+    def create_storage_class(self, sc: StorageClass) -> StorageClass:
+        return self.create(STORAGE_CLASSES, sc)

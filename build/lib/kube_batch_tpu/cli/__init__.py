@@ -1,0 +1,19 @@
+"""kbt-ctl — the queue admin CLI (reference cmd/cli/queue.go +
+pkg/cli/queue/{create,list}.go).
+
+The reference CLI talks to the Kubernetes API server with a generated
+clientset; here the scheduler server's HTTP queue API
+(kube_batch_tpu/server.py, the in-process CRD surface) is the backend:
+
+    kbt-ctl queue create --name q1 --weight 3
+    kbt-ctl queue list
+    kbt-ctl queue delete --name q1
+    kbt-ctl version
+
+`--server` points at the scheduler's listen address (the reference's
+--master/--kubeconfig pair collapses to one URL with no auth layer).
+"""
+
+from kube_batch_tpu.cli.queue import main
+
+__all__ = ["main"]

@@ -1,0 +1,167 @@
+"""Scheduler configuration schema + loader
+(reference pkg/scheduler/conf/scheduler_conf.go:20-56, pkg/scheduler/util.go:31-81,
+pkg/scheduler/plugins/defaults.go:22-52).
+
+The YAML shape matches the reference exactly::
+
+    actions: "enqueue, allocate, backfill"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+    - plugins:
+      - name: drf
+      - name: predicates
+      - name: proportion
+      - name: nodeorder
+        arguments:
+          leastrequested.weight: 2
+
+Every per-plugin enable flag defaults to True when unset
+(ApplyPluginConfDefaults).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+_ENABLE_FLAGS = (
+    "enabled_job_order",
+    "enabled_job_ready",
+    "enabled_job_pipelined",
+    "enabled_task_order",
+    "enabled_preemptable",
+    "enabled_reclaimable",
+    "enabled_queue_order",
+    "enabled_predicate",
+    "enabled_node_order",
+)
+
+_YAML_FLAG_KEYS = {
+    "enableJobOrder": "enabled_job_order",
+    "enableJobReady": "enabled_job_ready",
+    "enableJobPipelined": "enabled_job_pipelined",
+    "enableTaskOrder": "enabled_task_order",
+    "enablePreemptable": "enabled_preemptable",
+    "enableReclaimable": "enabled_reclaimable",
+    "enableQueueOrder": "enabled_queue_order",
+    "enablePredicate": "enabled_predicate",
+    "enableNodeOrder": "enabled_node_order",
+}
+
+
+@dataclass
+class PluginOption:
+    """reference scheduler_conf.go:32-56."""
+
+    name: str = ""
+    enabled_job_order: Optional[bool] = None
+    enabled_job_ready: Optional[bool] = None
+    enabled_job_pipelined: Optional[bool] = None
+    enabled_task_order: Optional[bool] = None
+    enabled_preemptable: Optional[bool] = None
+    enabled_reclaimable: Optional[bool] = None
+    enabled_queue_order: Optional[bool] = None
+    enabled_predicate: Optional[bool] = None
+    enabled_node_order: Optional[bool] = None
+    arguments: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Tier:
+    """reference scheduler_conf.go:27-30."""
+
+    plugins: list[PluginOption] = field(default_factory=list)
+
+
+@dataclass
+class SchedulerConfiguration:
+    """reference scheduler_conf.go:20-25, plus `action_arguments`: an
+    extension the reference schema does not have (its actions take no
+    conf arguments) carrying per-action knobs — e.g. xla_allocate's
+    `mesh` device-mesh selection::
+
+        actions: "enqueue, xla_allocate, backfill"
+        actionArguments:
+          xla_allocate:
+            mesh: auto
+    """
+
+    actions: str = ""
+    tiers: list[Tier] = field(default_factory=list)
+    action_arguments: dict[str, dict[str, str]] = field(default_factory=dict)
+
+
+# Default conf (reference util.go:31-42).
+DEFAULT_SCHEDULER_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def apply_plugin_conf_defaults(option: PluginOption) -> None:
+    """Unset enable flags default to True (reference defaults.go:22-52)."""
+    for flag in _ENABLE_FLAGS:
+        if getattr(option, flag) is None:
+            setattr(option, flag, True)
+
+
+def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
+    """YAML string -> SchedulerConfiguration with plugin defaults applied
+    (reference util.go:44-63)."""
+    data = yaml.safe_load(conf_str) or {}
+    conf = SchedulerConfiguration(actions=str(data.get("actions", "")))
+    for action_name, args in (data.get("actionArguments") or {}).items():
+        conf.action_arguments[str(action_name)] = {
+            str(k): str(v) for k, v in (args or {}).items()
+        }
+    for tier_data in data.get("tiers") or []:
+        tier = Tier()
+        for plugin_data in tier_data.get("plugins") or []:
+            option = PluginOption(name=str(plugin_data.get("name", "")))
+            for yaml_key, attr in _YAML_FLAG_KEYS.items():
+                if yaml_key in plugin_data:
+                    setattr(option, attr, bool(plugin_data[yaml_key]))
+            option.arguments = {
+                str(k): str(v) for k, v in (plugin_data.get("arguments") or {}).items()
+            }
+            apply_plugin_conf_defaults(option)
+            tier.plugins.append(option)
+        conf.tiers.append(tier)
+    return conf
+
+
+def load_scheduler_conf(conf_str: str):
+    """YAML -> ([Action], [Tier], action_arguments); unknown action names
+    raise (reference util.go:44-73). Imported lazily to avoid a framework
+    import cycle."""
+    from kube_batch_tpu.framework import get_action
+
+    conf = parse_scheduler_conf(conf_str)
+    actions = []
+    for action_name in conf.actions.split(","):
+        name = action_name.strip()
+        if not name:
+            continue
+        action = get_action(name)
+        if action is None:
+            raise ValueError(f"failed to find Action {name!r}")
+        actions.append(action)
+    return actions, conf.tiers, conf.action_arguments
+
+
+def read_scheduler_conf(conf_path: str) -> str:
+    """reference util.go:75-81."""
+    with open(conf_path, "r", encoding="utf-8") as f:
+        return f.read()

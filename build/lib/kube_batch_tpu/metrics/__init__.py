@@ -1,0 +1,307 @@
+"""In-process scheduling metrics (reference pkg/scheduler/metrics/metrics.go:38-121).
+
+The reference registers Prometheus collectors under subsystem "volcano":
+e2e/action/plugin/task latency histograms, schedule attempts, preemption
+victims/attempts, unschedulable task/job gauges, job retries. This module
+keeps the same metric set in-process (no client library dependency) and
+renders Prometheus text exposition for the server's /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Optional
+
+# Buckets: 5ms * 2^k for e2e (metrics.go:41-44), 5us * 2^k for the rest
+# (metrics.go:49-72). Values recorded in seconds.
+E2E_BUCKETS = tuple(0.005 * 2**k for k in range(12))
+FINE_BUCKETS = tuple(5e-6 * 2**k for k in range(18))
+
+
+class Histogram:
+    """Labeled histogram vector (one bucket series per label set, like the
+    reference's prometheus HistogramVec)."""
+
+    def __init__(self, name: str, help_text: str, buckets: Iterable[float]) -> None:
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        # label tuple -> [counts per bucket + overflow, sum, total]
+        self._series: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[dict[str, str]]) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = series
+            counts, _, _ = series
+            series[1] += value
+            series[2] += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    return
+            counts[-1] += 1
+
+    def observe_many(self, values, labels: Optional[dict[str, str]] = None) -> None:
+        """Batch observe: one lock acquisition for a whole list of values —
+        identical bucket counts/sum/total to calling observe per value.
+        ndarray input takes a vectorized path (searchsorted + bincount);
+        a 100k-bind gang dispatch feeds its whole latency vector here."""
+        import numpy as _np
+
+        if isinstance(values, _np.ndarray):
+            if values.size == 0:
+                return
+            buckets = self.buckets
+            nb = len(buckets)
+            # bisect_left == searchsorted side='left': first bucket with
+            # v <= bound (bucket bounds are inclusive upper edges)
+            idx = _np.searchsorted(_np.asarray(buckets), values, side="left")
+            add = _np.bincount(_np.minimum(idx, nb), minlength=nb + 1)
+            key = self._key(labels)
+            with self._lock:
+                series = self._series.get(key)
+                if series is None:
+                    series = [[0] * (nb + 1), 0.0, 0]
+                    self._series[key] = series
+                counts = series[0]
+                for i, c in enumerate(add.tolist()):
+                    counts[i] += c
+                series[1] += float(values.sum())
+                series[2] += int(values.size)
+            return
+        values = list(values)
+        if not values:
+            return
+        from bisect import bisect_left
+
+        buckets = self.buckets
+        nb = len(buckets)
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (nb + 1), 0.0, 0]
+                self._series[key] = series
+            counts = series[0]
+            for v in values:
+                i = bisect_left(buckets, v)  # first bucket with v <= bound
+                counts[i if i < nb else nb] += 1
+            series[1] += sum(values)
+            series[2] += len(values)
+
+    def snapshot(self, labels: Optional[dict[str, str]] = None) -> dict:
+        """Cumulative bucket counts for one label set (default: the sum
+        over all label sets)."""
+        with self._lock:
+            if labels is None:
+                merged = [0] * (len(self.buckets) + 1)
+                total_sum, total = 0.0, 0
+                for counts, s, n in self._series.values():
+                    for i, c in enumerate(counts):
+                        merged[i] += c
+                    total_sum += s
+                    total += n
+            else:
+                counts, total_sum, total = self._series.get(
+                    self._key(labels), [[0] * (len(self.buckets) + 1), 0.0, 0]
+                )
+                merged = list(counts)
+            cumulative = []
+            running = 0
+            for c in merged[:-1]:
+                running += c
+                cumulative.append(running)
+            return {
+                "buckets": dict(zip(self.buckets, cumulative)),
+                "sum": total_sum,
+                "count": total,
+            }
+
+    def label_sets(self) -> list[tuple]:
+        with self._lock:
+            return list(self._series)
+
+    def quantile(self, q: float, labels: Optional[dict[str, str]] = None) -> float:
+        """Approximate quantile from bucket boundaries (reference extracts
+        p50/p90/p99 the same way in test/e2e/metric_util.go:45-68)."""
+        snap = self.snapshot(labels)
+        if snap["count"] == 0:
+            return 0.0
+        target = math.ceil(q * snap["count"])
+        for boundary, cum in snap["buckets"].items():
+            if cum >= target:
+                return boundary
+        return float("inf")
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Optional[dict[str, str]] = None, by: float = 1.0) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._values[key] = value
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+
+_SUBSYSTEM = "kube_batch_tpu"
+
+e2e_scheduling_latency = Histogram(
+    f"{_SUBSYSTEM}_e2e_scheduling_latency", "E2E scheduling latency in seconds", E2E_BUCKETS
+)
+plugin_scheduling_latency = Histogram(
+    f"{_SUBSYSTEM}_plugin_scheduling_latency", "Plugin scheduling latency in seconds", FINE_BUCKETS
+)
+action_scheduling_latency = Histogram(
+    f"{_SUBSYSTEM}_action_scheduling_latency", "Action scheduling latency in seconds", FINE_BUCKETS
+)
+task_scheduling_latency = Histogram(
+    f"{_SUBSYSTEM}_task_scheduling_latency", "Task scheduling latency in seconds", FINE_BUCKETS
+)
+schedule_attempts = Counter(
+    f"{_SUBSYSTEM}_schedule_attempts_total",
+    "Number of attempts to schedule pods, by result",
+)
+preemption_victims = Counter(
+    f"{_SUBSYSTEM}_total_preemption_victims", "Number of selected preemption victims"
+)
+preemption_attempts = Counter(
+    f"{_SUBSYSTEM}_total_preemption_attempts", "Total preemption attempts in the cluster"
+)
+unschedule_task_count = Gauge(
+    f"{_SUBSYSTEM}_unschedule_task_count", "Number of tasks could not be scheduled"
+)
+unschedule_job_count = Gauge(
+    f"{_SUBSYSTEM}_unschedule_job_count", "Number of jobs could not be scheduled"
+)
+job_retry_counts = Counter(f"{_SUBSYSTEM}_job_retry_counts", "Number of retry counts for one job")
+
+
+def update_e2e_duration(seconds: float) -> None:
+    e2e_scheduling_latency.observe(seconds)
+
+
+def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
+    plugin_scheduling_latency.observe(seconds, {"plugin": plugin, "OnSession": phase})
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    action_scheduling_latency.observe(seconds, {"action": action})
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    task_scheduling_latency.observe(seconds)
+
+
+def update_task_schedule_durations(seconds_list) -> None:
+    """Batch form of update_task_schedule_duration (bulk gang dispatch)."""
+    task_scheduling_latency.observe_many(seconds_list)
+
+
+def update_preemption_victims_count(count: int) -> None:
+    preemption_victims.inc(by=count)
+
+
+def register_preemption_attempts() -> None:
+    preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job_name: str, count: int) -> None:
+    unschedule_task_count.set(count, {"job_id": job_name})
+
+
+def update_unschedule_job_count(count: int) -> None:
+    unschedule_job_count.set(count)
+
+
+def register_job_retries(job_name: str) -> None:
+    job_retry_counts.inc({"job_id": job_name})
+
+
+def _render_family(metric) -> list[str]:
+    lines = [f"# HELP {metric.name} {metric.help}"]
+    if isinstance(metric, Histogram):
+        lines.append(f"# TYPE {metric.name} histogram")
+        label_sets = metric.label_sets() or [()]
+        for key in label_sets:
+            labels = dict(key)
+            snap = metric.snapshot(labels if key else None)
+            prefix = ",".join(f'{k}="{v}"' for k, v in key)
+            sep = "," if prefix else ""
+            for boundary, cum in snap["buckets"].items():
+                lines.append(
+                    f'{metric.name}_bucket{{{prefix}{sep}le="{boundary}"}} {cum}'
+                )
+            lines.append(f'{metric.name}_bucket{{{prefix}{sep}le="+Inf"}} {snap["count"]}')
+            suffix = f"{{{prefix}}}" if prefix else ""
+            lines.append(f"{metric.name}_sum{suffix} {snap['sum']}")
+            lines.append(f"{metric.name}_count{suffix} {snap['count']}")
+    else:
+        kind = "counter" if isinstance(metric, Counter) else "gauge"
+        lines.append(f"# TYPE {metric.name} {kind}")
+        with metric._lock:
+            items = dict(metric._values)
+        if not items:
+            lines.append(f"{metric.name} 0")
+        for key, value in items.items():
+            if key:
+                label_str = ",".join(f'{k}="{v}"' for k, v in key)
+                lines.append(f"{metric.name}{{{label_str}}} {value}")
+            else:
+                lines.append(f"{metric.name} {value}")
+    return lines
+
+
+def render_prometheus_text() -> str:
+    """Prometheus text exposition for all registered metrics."""
+    families = [
+        e2e_scheduling_latency,
+        plugin_scheduling_latency,
+        action_scheduling_latency,
+        task_scheduling_latency,
+        schedule_attempts,
+        preemption_victims,
+        preemption_attempts,
+        unschedule_task_count,
+        unschedule_job_count,
+        job_retry_counts,
+    ]
+    lines: list[str] = []
+    for metric in families:
+        lines.extend(_render_family(metric))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,26 @@
+"""Native (C++) hot loops with a pure-Python fallback.
+
+`lib` is the compiled `_hotloops` module, or None when it cannot be
+built/loaded (no toolchain, unsupported platform) or is disabled via
+``KBT_NATIVE=0`` — callers must keep their Python path for that case.
+The build is lazy and cached next to the source (native/build.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("kube_batch_tpu.native")
+
+lib = None
+
+if os.environ.get("KBT_NATIVE", "1") != "0":
+    try:
+        from kube_batch_tpu.native import build as _build
+
+        _build.ensure()
+        from kube_batch_tpu.native import _hotloops as lib  # noqa: F401
+    except Exception as e:  # noqa: BLE001 -- any failure means fallback
+        log.info("native hot loops unavailable (%s); using Python loops", e)
+        lib = None

@@ -1,0 +1,577 @@
+/* Native hot loops for the replay path (CPython extension).
+ *
+ * The reference scheduler's runtime is compiled Go end to end; here the
+ * TPU solve is compiled XLA/Mosaic, and this module compiles the one
+ * remaining interpreter-bound stretch: the bulk session-mutation loop
+ * that replays kernel assignments into Python session objects
+ * (actions/xla_allocate._Replayer.apply_upto — the net state mutations
+ * of ssn.allocate/pipeline, session.go:198-296, at 50k-100k events per
+ * cycle).
+ *
+ * Approach: TaskInfo (api/job_info.py) is a __slots__ class, so its
+ * attributes live at fixed byte offsets published by the class's
+ * member descriptors (PyMemberDescrObject.d_member->offset). We cache
+ * the offsets per type and do the per-event work — status flip,
+ * node_name set, residency clone (clone_for_residency parity: shares
+ * Resource objects, copies every slot), node task-map insert, status-
+ * index dict build — as direct pointer stores + PyDict_SetItem calls,
+ * with no interpreter frames. Everything is plain public CPython API
+ * (descrobject.h, PyType_GenericAlloc via tp_alloc); a type without
+ * the expected slots raises and the caller falls back to the pure-
+ * Python loop.
+ *
+ * Build: kube_batch_tpu/native/build.py (g++ -O2 -shared -fPIC);
+ * loaded lazily by kube_batch_tpu/native/__init__.py with a pure-
+ * Python fallback when the toolchain is absent (KBT_NATIVE=0 disables).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+
+#include <cstring>
+
+namespace {
+
+/* ---- slot offset cache --------------------------------------------------- */
+
+constexpr int kNumSlots = 11;
+/* Order matches TaskInfo.__slots__ (api/job_info.py); the clone copies
+ * all of them, the surgery writes a subset. */
+const char* const kSlotNames[kNumSlots] = {
+    "uid",     "job",    "name",     "namespace", "resreq", "init_resreq",
+    "node_name", "status", "priority", "volume_ready", "pod",
+};
+constexpr int kUid = 0;
+constexpr int kNodeName = 6;
+constexpr int kStatus = 7;
+constexpr int kVolumeReady = 9;
+constexpr int kPod = 10;
+
+struct SlotCache {
+  PyTypeObject* type = nullptr;  // borrowed; identity-checked per call
+  Py_ssize_t off[kNumSlots];
+};
+
+SlotCache g_task_slots;
+
+/* Resolve the byte offset of each __slots__ member descriptor on `tp`.
+ * Returns 0 on success, -1 (with a Python error set) when any name is
+ * not a plain member slot — the caller then uses the Python path.
+ * Resolves into a local table and commits atomically so a mid-loop
+ * failure cannot leave a half-overwritten cache behind a stale type
+ * identity. */
+int resolve_slots(PyTypeObject* tp, SlotCache* cache) {
+  SlotCache local;
+  for (int i = 0; i < kNumSlots; i++) {
+    PyObject* descr = PyObject_GetAttrString((PyObject*)tp, kSlotNames[i]);
+    if (descr == nullptr) return -1;
+    if (Py_TYPE(descr) != &PyMemberDescr_Type) {
+      Py_DECREF(descr);
+      PyErr_Format(PyExc_TypeError, "%s.%s is not a slot member",
+                   tp->tp_name, kSlotNames[i]);
+      return -1;
+    }
+    local.off[i] = ((PyMemberDescrObject*)descr)->d_member->offset;
+    Py_DECREF(descr);
+  }
+  local.type = tp;
+  *cache = local;
+  return 0;
+}
+
+inline PyObject* get_slot(PyObject* o, Py_ssize_t off) {
+  return *(PyObject**)((char*)o + off);  // borrowed
+}
+
+inline void set_slot(PyObject* o, Py_ssize_t off, PyObject* v) {
+  PyObject** p = (PyObject**)((char*)o + off);
+  Py_INCREF(v);
+  PyObject* old = *p;
+  *p = v;
+  Py_XDECREF(old);
+}
+
+/* clone_for_residency parity: new instance of the same type, every slot
+ * shared by reference (Resource objects included — they are never
+ * mutated on a TaskInfo after construction; see job_info.py docstring).
+ *
+ * The clone is removed from cycle-GC tracking: nothing a TaskInfo
+ * references (strings, Resource, Pod, TaskStatus) can reach the clone
+ * back — the clone lives only in NodeInfo.tasks, never in the job
+ * indexes — so it cannot participate in a cycle and plain refcounting
+ * frees it. Untracking keeps 50k-100k fresh clones out of every gen-0
+ * collection during the replay. */
+PyObject* clone_slots(PyObject* task, const SlotCache& sc) {
+  PyTypeObject* tp = Py_TYPE(task);
+  PyObject* cl = tp->tp_alloc(tp, 0);
+  if (cl == nullptr) return nullptr;
+  for (int i = 0; i < kNumSlots; i++) {
+    PyObject* v = get_slot(task, sc.off[i]);
+    Py_XINCREF(v);
+    *(PyObject**)((char*)cl + sc.off[i]) = v;
+  }
+  if (PyObject_GC_IsTracked(cl)) PyObject_GC_UnTrack(cl);
+  return cl;
+}
+
+/* ---- bulk_assign --------------------------------------------------------- */
+
+PyObject* g_volumes_name = nullptr;  // interned "volumes"
+
+/* bulk_assign(tasks, tkeys, node_tasks, node_names, rows, nrows,
+ *             allocs, counts, ALLOCATED, PIPELINED)
+ *
+ *   tasks      list[TaskInfo]  row-indexed (encoder order)
+ *   tkeys      list[str]       row-indexed "ns/name" node-map keys
+ *   node_tasks list[dict]      per node row: NodeInfo.tasks
+ *   node_names list[str]       per node row: node name
+ *   rows       list[int]       event rows, kernel order grouped per job
+ *   nrows      list[int]       event node rows (parallel to rows)
+ *   allocs     bytes           1 = Allocated, 0 = Pipelined (parallel)
+ *   counts     list[int]       events per job segment (sum = len(rows))
+ *   ALLOCATED / PIPELINED      TaskStatus members
+ *
+ * Per event, exactly the Python loop's mutations in its order:
+ *   volume_ready=True (Allocated, volume-less), status flip, uid->task
+ *   into the segment's alloc/pipe dict, node_name set, residency clone
+ *   into node_tasks[nrow][tkeys[row]].
+ * Returns list[(alloc_d, pipe_d)] per segment.
+ *
+ * A task with pod.volumes on an Allocated event needs the volume
+ * binder (host-side assume) — detected in a mutation-free prepass and
+ * raised as ValueError so the caller falls back cleanly. */
+PyObject* bulk_assign(PyObject*, PyObject* args) {
+  PyObject *tasks, *tkeys, *node_tasks, *node_names, *rows, *nrows;
+  PyObject *allocs, *counts, *st_alloc, *st_pipe;
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!SO!OO", &PyList_Type, &tasks,
+                        &PyList_Type, &tkeys, &PyList_Type, &node_tasks,
+                        &PyList_Type, &node_names, &PyList_Type, &rows,
+                        &PyList_Type, &nrows, &allocs, &PyList_Type, &counts,
+                        &st_alloc, &st_pipe))
+    return nullptr;
+
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  if (PyList_GET_SIZE(nrows) != n || PyBytes_GET_SIZE(allocs) != n) {
+    PyErr_SetString(PyExc_ValueError, "rows/nrows/allocs length mismatch");
+    return nullptr;
+  }
+  const char* is_alloc = PyBytes_AS_STRING(allocs);
+  Py_ssize_t n_tasks = PyList_GET_SIZE(tasks);
+  Py_ssize_t n_nodes = PyList_GET_SIZE(node_tasks);
+  if (PyList_GET_SIZE(tkeys) != n_tasks ||
+      PyList_GET_SIZE(node_names) != n_nodes) {
+    PyErr_SetString(PyExc_ValueError, "tkeys/node_names length mismatch");
+    return nullptr;
+  }
+
+  /* Decode row/nrow indices once, bounds-checked. */
+  Py_ssize_t* row_ix = (Py_ssize_t*)PyMem_Malloc(2 * n * sizeof(Py_ssize_t));
+  if (row_ix == nullptr && n > 0) return PyErr_NoMemory();
+  Py_ssize_t* nrow_ix = row_ix + n;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t r = PyLong_AsSsize_t(PyList_GET_ITEM(rows, i));
+    Py_ssize_t nr = PyLong_AsSsize_t(PyList_GET_ITEM(nrows, i));
+    if ((r == -1 || nr == -1) && PyErr_Occurred()) goto fail_ix;
+    if (r < 0 || r >= n_tasks || nr < 0 || nr >= n_nodes) {
+      PyErr_SetString(PyExc_IndexError, "row index out of range");
+      goto fail_ix;
+    }
+    row_ix[i] = r;
+    nrow_ix[i] = nr;
+  }
+
+  {
+    /* Slot offsets for this TaskInfo type (cached across calls). */
+    if (n > 0) {
+      PyTypeObject* tp = Py_TYPE(PyList_GET_ITEM(tasks, row_ix[0]));
+      if (g_task_slots.type != tp && resolve_slots(tp, &g_task_slots) < 0)
+        goto fail_ix;
+    }
+    const SlotCache& sc = g_task_slots;
+
+    /* Mutation-free prepass: homogeneous types + the volume guard. */
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* task = PyList_GET_ITEM(tasks, row_ix[i]);
+      if (Py_TYPE(task) != sc.type) {
+        PyErr_SetString(PyExc_TypeError, "mixed TaskInfo types in batch");
+        goto fail_ix;
+      }
+      if (is_alloc[i]) {
+        PyObject* pod = get_slot(task, sc.off[kPod]);
+        PyObject* vols =
+            pod ? PyObject_GetAttr(pod, g_volumes_name) : nullptr;
+        if (vols == nullptr) goto fail_ix;
+        int truthy = PyObject_IsTrue(vols);
+        Py_DECREF(vols);
+        if (truthy < 0) goto fail_ix;
+        if (truthy) {
+          PyErr_SetString(PyExc_ValueError,
+                          "bulk row carries volume claims (needs host-side "
+                          "assume); use the Python path");
+          goto fail_ix;
+        }
+      }
+    }
+
+    Py_ssize_t n_seg = PyList_GET_SIZE(counts);
+    PyObject* out = PyList_New(n_seg);
+    if (out == nullptr) goto fail_ix;
+    Py_ssize_t i = 0;
+    for (Py_ssize_t s = 0; s < n_seg; s++) {
+      Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(counts, s));
+      if (cnt == -1 && PyErr_Occurred()) goto fail_out;
+      PyObject* alloc_d = PyDict_New();
+      PyObject* pipe_d = PyDict_New();
+      PyObject* pair = (alloc_d && pipe_d) ? PyTuple_Pack(2, alloc_d, pipe_d)
+                                           : nullptr;
+      Py_XDECREF(alloc_d);
+      Py_XDECREF(pipe_d);
+      if (pair == nullptr) goto fail_out;
+      PyList_SET_ITEM(out, s, pair);
+      Py_ssize_t end = i + cnt;
+      if (end > n) {
+        PyErr_SetString(PyExc_ValueError, "counts exceed event total");
+        goto fail_out;
+      }
+      for (; i < end; i++) {
+        PyObject* task = PyList_GET_ITEM(tasks, row_ix[i]);
+        PyObject* uid = get_slot(task, sc.off[kUid]);
+        if (is_alloc[i]) {
+          set_slot(task, sc.off[kVolumeReady], Py_True);
+          set_slot(task, sc.off[kStatus], st_alloc);
+          if (PyDict_SetItem(alloc_d, uid, task) < 0) goto fail_out;
+        } else {
+          set_slot(task, sc.off[kStatus], st_pipe);
+          if (PyDict_SetItem(pipe_d, uid, task) < 0) goto fail_out;
+        }
+        set_slot(task, sc.off[kNodeName],
+                 PyList_GET_ITEM(node_names, nrow_ix[i]));
+        PyObject* cl = clone_slots(task, sc);
+        if (cl == nullptr) goto fail_out;
+        PyObject* ntd = PyList_GET_ITEM(node_tasks, nrow_ix[i]);
+        int rc = PyDict_SetItem(ntd, PyList_GET_ITEM(tkeys, row_ix[i]), cl);
+        Py_DECREF(cl);
+        if (rc < 0) goto fail_out;
+      }
+    }
+    if (i != n) {
+      PyErr_SetString(PyExc_ValueError, "counts do not cover all events");
+      goto fail_out;
+    }
+    PyMem_Free(row_ix);
+    return out;
+  fail_out:
+    Py_DECREF(out);
+  }
+fail_ix:
+  PyMem_Free(row_ix);
+  return nullptr;
+}
+
+/* ---- encode-side extractors ---------------------------------------------- */
+
+constexpr int kSlotJob = 1;
+constexpr int kSlotResreq = 4;
+constexpr int kSlotInitResreq = 5;
+
+/* Resource slots (api/resource_info.py). */
+constexpr int kNumResSlots = 3;
+const char* const kResSlotNames[kNumResSlots] = {"milli_cpu", "memory",
+                                                 "scalars"};
+struct ResSlotCache {
+  PyTypeObject* type = nullptr;
+  Py_ssize_t off[kNumResSlots];
+};
+ResSlotCache g_res_slots;
+
+int resolve_res_slots(PyTypeObject* tp, ResSlotCache* cache) {
+  ResSlotCache local;  // committed atomically; see resolve_slots
+  for (int i = 0; i < kNumResSlots; i++) {
+    PyObject* descr = PyObject_GetAttrString((PyObject*)tp, kResSlotNames[i]);
+    if (descr == nullptr) return -1;
+    if (Py_TYPE(descr) != &PyMemberDescr_Type) {
+      Py_DECREF(descr);
+      PyErr_Format(PyExc_TypeError, "%s.%s is not a slot member",
+                   tp->tp_name, kResSlotNames[i]);
+      return -1;
+    }
+    local.off[i] = ((PyMemberDescrObject*)descr)->d_member->offset;
+    Py_DECREF(descr);
+  }
+  local.type = tp;
+  *cache = local;
+  return 0;
+}
+
+struct F32F64Buf {
+  Py_buffer view{};
+  bool is_f64 = false;
+  bool ok = false;
+};
+
+/* Acquire a writable C-contiguous float32/float64 buffer. */
+bool get_float_buf(PyObject* obj, F32F64Buf* b, int want_ndim) {
+  if (PyObject_GetBuffer(obj, &b->view, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE |
+                                            PyBUF_FORMAT) < 0)
+    return false;
+  b->ok = true;
+  const char* f = b->view.format;
+  if (b->view.ndim != want_ndim || f == nullptr ||
+      !((f[0] == 'f' || f[0] == 'd') && f[1] == '\0')) {
+    PyErr_SetString(PyExc_TypeError,
+                    "expected a C-contiguous float32/float64 buffer");
+    return false;
+  }
+  b->is_f64 = b->view.format[0] == 'd';
+  return true;
+}
+
+inline void put_f(const F32F64Buf& b, Py_ssize_t flat_ix, double v) {
+  if (b.is_f64)
+    ((double*)b.view.buf)[flat_ix] = v;
+  else
+    ((float*)b.view.buf)[flat_ix] = (float)v;
+}
+
+/* Read resource.milli_cpu / resource.memory as doubles; -1 on error. */
+inline int res_cpu_mem(PyObject* res, const ResSlotCache& rc, double* cpu,
+                       double* mem) {
+  PyObject* c = get_slot(res, rc.off[0]);
+  PyObject* m = get_slot(res, rc.off[1]);
+  if (c == nullptr || m == nullptr) {
+    PyErr_SetString(PyExc_AttributeError, "Resource slot unset");
+    return -1;
+  }
+  *cpu = PyFloat_AsDouble(c);
+  if (*cpu == -1.0 && PyErr_Occurred()) return -1;
+  *mem = PyFloat_AsDouble(m);
+  if (*mem == -1.0 && PyErr_Occurred()) return -1;
+  return 0;
+}
+
+/* extract_task_columns(tasks, job_idx, req, res, job_out, has_sc,
+ *                      res_has_sc)
+ *
+ * The scalar-less encoder fast path (ops/encode.py): for task i write
+ *   req[i,0:2]  = init_resreq.{milli_cpu,memory}
+ *   res[i,0:2]  = resreq.{milli_cpu,memory}
+ *   job_out[i]  = job_idx[task.job]          (int32)
+ *   has_sc[i]   = bool(init_resreq.scalars)  (uint8/bool)
+ *   res_has_sc[i] = bool(resreq.scalars)
+ * req/res are the [T,R] padded arrays (T >= len(tasks)); only the first
+ * len(tasks) rows and two columns are touched. */
+PyObject* extract_task_columns(PyObject*, PyObject* args) {
+  PyObject *tasks, *job_idx, *req_o, *res_o, *job_o, *hs_o, *rhs_o;
+  if (!PyArg_ParseTuple(args, "O!O!OOOOO", &PyList_Type, &tasks, &PyDict_Type,
+                        &job_idx, &req_o, &res_o, &job_o, &hs_o, &rhs_o))
+    return nullptr;
+
+  F32F64Buf req, res;
+  Py_buffer job_b{}, hs_b{}, rhs_b{};
+  bool job_ok = false, hs_ok = false, rhs_ok = false;
+  PyObject* ret = nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(tasks);
+
+  if (!get_float_buf(req_o, &req, 2) || !get_float_buf(res_o, &res, 2))
+    goto done;
+  if (PyObject_GetBuffer(job_o, &job_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  job_ok = true;
+  if (PyObject_GetBuffer(hs_o, &hs_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  hs_ok = true;
+  if (PyObject_GetBuffer(rhs_o, &rhs_b,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    goto done;
+  rhs_ok = true;
+
+  if (job_b.itemsize != 4 || hs_b.itemsize != 1 || rhs_b.itemsize != 1 ||
+      req.view.shape[0] < n || res.view.shape[0] < n || job_b.len < 4 * n ||
+      hs_b.len < n || rhs_b.len < n || req.view.shape[1] < 2 ||
+      res.view.shape[1] < 2) {
+    PyErr_SetString(PyExc_ValueError, "output buffer shape/dtype mismatch");
+    goto done;
+  }
+
+  {
+    Py_ssize_t req_R = req.view.shape[1], res_R = res.view.shape[1];
+    int32_t* job_out = (int32_t*)job_b.buf;
+    char* hs = (char*)hs_b.buf;
+    char* rhs = (char*)rhs_b.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* task = PyList_GET_ITEM(tasks, i);
+      PyTypeObject* tp = Py_TYPE(task);
+      if (g_task_slots.type != tp && resolve_slots(tp, &g_task_slots) < 0)
+        goto done;
+      const SlotCache& sc = g_task_slots;
+      PyObject* rr = get_slot(task, sc.off[kSlotResreq]);
+      PyObject* ir = get_slot(task, sc.off[kSlotInitResreq]);
+      if (rr == nullptr || ir == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "task resource slot unset");
+        goto done;
+      }
+      PyTypeObject* rtp = Py_TYPE(rr);
+      if (g_res_slots.type != rtp && resolve_res_slots(rtp, &g_res_slots) < 0)
+        goto done;
+      if (Py_TYPE(ir) != g_res_slots.type) {
+        PyErr_SetString(PyExc_TypeError, "mixed Resource types");
+        goto done;
+      }
+      const ResSlotCache& rc = g_res_slots;
+      double cpu, mem;
+      if (res_cpu_mem(ir, rc, &cpu, &mem) < 0) goto done;
+      put_f(req, i * req_R + 0, cpu);
+      put_f(req, i * req_R + 1, mem);
+      if (res_cpu_mem(rr, rc, &cpu, &mem) < 0) goto done;
+      put_f(res, i * res_R + 0, cpu);
+      put_f(res, i * res_R + 1, mem);
+      PyObject* jid = get_slot(task, sc.off[kSlotJob]);
+      PyObject* jrow = jid ? PyDict_GetItemWithError(job_idx, jid) : nullptr;
+      if (jrow == nullptr) {
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_KeyError, "task.job not in job_idx");
+        goto done;
+      }
+      long j = PyLong_AsLong(jrow);
+      if (j == -1 && PyErr_Occurred()) goto done;
+      job_out[i] = (int32_t)j;
+      int t1 = PyObject_IsTrue(get_slot(ir, rc.off[2]));
+      int t2 = PyObject_IsTrue(get_slot(rr, rc.off[2]));
+      if (t1 < 0 || t2 < 0) goto done;
+      hs[i] = (char)t1;
+      rhs[i] = (char)t2;
+    }
+  }
+  ret = Py_NewRef(Py_None);
+
+done:
+  if (req.ok) PyBuffer_Release(&req.view);
+  if (res.ok) PyBuffer_Release(&res.view);
+  if (job_ok) PyBuffer_Release(&job_b);
+  if (hs_ok) PyBuffer_Release(&hs_b);
+  if (rhs_ok) PyBuffer_Release(&rhs_b);
+  return ret;
+}
+
+/* extract_node_columns(nodes, names, out) — the node-side scalar-less
+ * fast path: nodes is list[NodeInfo], names a tuple of attribute names
+ * (e.g. ("idle","releasing","used","allocatable")), out a writable
+ * [len(names), N, R] float buffer; writes out[a, i, 0:2] =
+ * node.<names[a]>.{milli_cpu,memory}. */
+PyObject* extract_node_columns(PyObject*, PyObject* args) {
+  PyObject *nodes, *names, *out_o;
+  if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &nodes, &PyTuple_Type,
+                        &names, &out_o))
+    return nullptr;
+  F32F64Buf out;
+  PyObject* ret = nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(nodes);
+  Py_ssize_t na = PyTuple_GET_SIZE(names);
+  if (PyObject_GetBuffer(out_o, &out.view, PyBUF_C_CONTIGUOUS |
+                                               PyBUF_WRITABLE | PyBUF_FORMAT) <
+      0)
+    return nullptr;
+  out.ok = true;
+  {
+    const char* f = out.view.format;
+    if (out.view.ndim != 3 || f == nullptr ||
+        !((f[0] == 'f' || f[0] == 'd') && f[1] == '\0') ||
+        out.view.shape[0] != na || out.view.shape[1] < n ||
+        out.view.shape[2] < 2) {
+      PyErr_SetString(PyExc_ValueError, "output buffer shape/dtype mismatch");
+      goto done;
+    }
+    out.is_f64 = f[0] == 'd';
+    Py_ssize_t N = out.view.shape[1], R = out.view.shape[2];
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* node = PyList_GET_ITEM(nodes, i);
+      for (Py_ssize_t a = 0; a < na; a++) {
+        PyObject* res = PyObject_GetAttr(node, PyTuple_GET_ITEM(names, a));
+        if (res == nullptr) goto done;
+        PyTypeObject* rtp = Py_TYPE(res);
+        if (g_res_slots.type != rtp &&
+            resolve_res_slots(rtp, &g_res_slots) < 0) {
+          Py_DECREF(res);
+          goto done;
+        }
+        double cpu, mem;
+        int rc = res_cpu_mem(res, g_res_slots, &cpu, &mem);
+        Py_DECREF(res);
+        if (rc < 0) goto done;
+        put_f(out, (a * N + i) * R + 0, cpu);
+        put_f(out, (a * N + i) * R + 1, mem);
+      }
+    }
+  }
+  ret = Py_NewRef(Py_None);
+done:
+  PyBuffer_Release(&out.view);
+  return ret;
+}
+
+/* ---- bulk_set_slot ------------------------------------------------------- */
+
+/* bulk_set_slot(objs, name, value): obj.<name> = value for every obj —
+ * the gang-dispatch status flip (finish()) without 100k interpreter
+ * stores. Objects must share one __slots__ type. */
+PyObject* bulk_set_slot(PyObject*, PyObject* args) {
+  PyObject *objs, *name, *value;
+  if (!PyArg_ParseTuple(args, "O!UO", &PyList_Type, &objs, &name, &value))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(objs);
+  if (n == 0) Py_RETURN_NONE;
+  PyTypeObject* tp = Py_TYPE(PyList_GET_ITEM(objs, 0));
+  PyObject* descr = PyObject_GetAttr((PyObject*)tp, name);
+  if (descr == nullptr) return nullptr;
+  if (Py_TYPE(descr) != &PyMemberDescr_Type) {
+    Py_DECREF(descr);
+    PyErr_Format(PyExc_TypeError, "%s.%U is not a slot member", tp->tp_name,
+                 name);
+    return nullptr;
+  }
+  Py_ssize_t off = ((PyMemberDescrObject*)descr)->d_member->offset;
+  Py_DECREF(descr);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* o = PyList_GET_ITEM(objs, i);
+    if (Py_TYPE(o) != tp) {
+      PyErr_SetString(PyExc_TypeError, "mixed object types in batch");
+      return nullptr;
+    }
+    set_slot(o, off, value);
+  }
+  Py_RETURN_NONE;
+}
+
+/* ---- module -------------------------------------------------------------- */
+
+PyMethodDef methods[] = {
+    {"bulk_assign", bulk_assign, METH_VARARGS,
+     "Apply kernel assignment events to session TaskInfo/node state."},
+    {"bulk_set_slot", bulk_set_slot, METH_VARARGS,
+     "Set one __slots__ attribute on every object in a list."},
+    {"extract_task_columns", extract_task_columns, METH_VARARGS,
+     "Fill SoA request/limit/job/scalar-flag columns from TaskInfos."},
+    {"extract_node_columns", extract_node_columns, METH_VARARGS,
+     "Fill [A,N,R] cpu/mem columns from NodeInfo resource attributes."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_hotloops",
+    "Native bulk session-mutation loops (see module docstring in source).",
+    -1, methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__hotloops(void) {
+  g_volumes_name = PyUnicode_InternFromString("volumes");
+  if (g_volumes_name == nullptr) return nullptr;
+  return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,56 @@
+"""Build the native hot-loop extension with the system toolchain.
+
+No pip/pybind11: the module is plain CPython C API, compiled with g++
+straight against this interpreter's headers. `ensure()` is idempotent
+and cheap — it rebuilds only when `_hotloops.cpp` is newer than the
+built artifact — so the package can call it lazily at import and a
+toolchain-less host simply falls back to the pure-Python loops.
+
+Manual (re)build:  python -m kube_batch_tpu.native.build
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "_hotloops.cpp")
+
+
+def artifact_path() -> str:
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, "_hotloops" + ext)
+
+
+def ensure(verbose: bool = False) -> str:
+    """Build if stale/missing; return the artifact path."""
+    out = artifact_path()
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(SOURCE):
+        return out
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent builds race on os.replace, not on the write
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-fPIC",
+        "-shared",
+        f"-I{include}",
+        SOURCE,
+        "-o",
+        tmp,
+    ]
+    subprocess.run(
+        cmd,
+        check=True,
+        stdout=None if verbose else subprocess.DEVNULL,
+        stderr=None if verbose else subprocess.PIPE,
+    )
+    os.replace(tmp, out)  # atomic vs concurrent importers
+    return out
+
+
+if __name__ == "__main__":
+    print(ensure(verbose=True))

@@ -1,0 +1,17 @@
+"""kube_batch_tpu.ops: the TPU compute path.
+
+The reference schedules serially — per task, a 16-goroutine scan over all
+nodes for predicates and priorities (reference
+pkg/scheduler/util/scheduler_helper.go:34-109) inside the allocate loop
+(actions/allocate/allocate.go:94-190). Here the same cycle is one XLA
+program: the cluster snapshot is encoded as struct-of-arrays tensors
+(`encode`), and a jitted `lax.while_loop` performs the full
+queue/job/task-ordered, gang-aware assignment with every per-node scan
+vectorized (`kernels`). The serial actions remain the correctness oracle;
+property tests pin serial ≡ XLA assignment-for-assignment.
+"""
+
+from kube_batch_tpu.ops.encode import EncodedSnapshot, encode_session
+from kube_batch_tpu.ops.kernels import solve_allocate
+
+__all__ = ["EncodedSnapshot", "encode_session", "solve_allocate"]

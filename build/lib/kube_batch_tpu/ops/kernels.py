@@ -1,0 +1,451 @@
+"""Jitted gang-aware allocation solve (the vectorized allocate action).
+
+One `lax.while_loop` iteration = one step of the serial allocate loop
+(reference actions/allocate/allocate.go:94-190): select the active queue
+(proportion share asc, then static creation/uid rank —
+session_plugins.go:280-305 + proportion.go:146-159), drop it for the
+cycle if overused (proportion.go:188-199; shares only grow during
+allocate, so one failed check is final — exactly like the serial heap
+draining the queue's remaining entries), select the next job from it
+(priority desc -> gang non-ready-first -> drf share asc -> creation/uid;
+priority.go:61-77 + gang.go:96-118 + drf.go:114-132 + session fallback),
+take its next pending task, and assign it to the best feasible node —
+except that the per-task predicate scan (HOT LOOP #1,
+scheduler_helper.go:34-57) and the scoring scan (HOT LOOP #2,
+scheduler_helper.go:60-109) are single vectorized ops over the whole node
+axis instead of a 16-goroutine fan-out:
+
+- feasibility: epsilon-tolerant resource fit against idle OR releasing
+  (allocate.go:78-92 + resource_info.go:255-278, including the Go
+  nil-scalar-map parity flags), precomputed label-compat gather
+  (selector/taints/cordon), pod-count room, dynamic host-port bitmask;
+- score: LeastRequested + BalancedResourceAllocation integer formulas
+  plus the precomputed preferred-node-affinity term (nodeorder.go:109-222),
+  argmax with first-node tie-break (= deterministic SelectBestNode);
+- assignment: fits-idle -> allocate (consume idle, ready_count++), else
+  -> pipeline onto releasing (node_info.go:108-136 accounting), with the
+  gang barrier — a job reaching min_available is re-queued so other jobs
+  get their turn, exactly like the serial heap re-push (allocate.go:182-185).
+
+Round-3 redesign (VERDICT r2 item 1): tasks are laid out contiguously per
+job by the encoder and each job keeps a next-task *pointer*, so the loop
+body does **no O(T) work** — a task pop is one dynamic-slice instead of a
+65k-element masked argmin. Each iteration is O(J + Q + N*R) of pure
+vector work dominated by the [N,R] fit/score block (the VPU payload);
+iterations are bounded by T + J + Q + 1 (one per task pop, one per job
+drop, one per overused/emptied queue drop).
+
+drf and proportion fold into the loop state (SURVEY.md section 7 hard
+part (d)): per-job allocated vectors -> dominant share (drf.go:161-171),
+per-queue allocated vs the statically water-filled deserved ->
+queue share + the overused gate (proportion.go:101-223), updated after
+every assignment exactly like the plugins' session event handlers.
+They are static jit flags, so the no-drf/no-proportion program carries
+no extra work.
+
+The kernel is policy-exact for the reference's *default* conf
+(util.go:31-42: priority,gang,conformance / drf,predicates,proportion,
+nodeorder) minus pairwise pod-affinity, which stays host-side — see
+encode.host_only and the segmented hybrid in actions/xla_allocate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAX_PRIORITY = 10  # schedulerapi.MaxPriority (nodeorder.py)
+
+KIND_NONE = 0
+KIND_ALLOCATED = 1
+KIND_PIPELINED = 2
+
+
+class SolveResult(NamedTuple):
+    assigned_node: jax.Array  # [T] int32, node row or -1
+    assigned_kind: jax.Array  # [T] int32, KIND_*
+    assign_pos: jax.Array  # [T] int32, order of the assignment event, or -1
+    ready_cnt: jax.Array  # [J] int32, final ready_task_num per job
+    n_assigned: jax.Array  # int32
+
+
+class SolveState(NamedTuple):
+    """Resumable mid-solve state for the segmented pod-affinity hybrid
+    (actions/xla_allocate): the host serial-steps one host-only task,
+    patches the node/job vectors, and re-enters the kernel."""
+
+    it: jax.Array
+    step: jax.Array
+    cur: jax.Array
+    ptr: jax.Array  # [J] next-task row per job
+    assigned_node: jax.Array
+    assigned_kind: jax.Array
+    assign_pos: jax.Array
+    idle: jax.Array
+    rel: jax.Array
+    used: jax.Array
+    ntasks: jax.Array
+    nports: jax.Array
+    ready_cnt: jax.Array
+    job_active: jax.Array
+    q_dropped: jax.Array
+    job_alloc: jax.Array  # [J,R] drf allocated (zeros when drf off)
+    q_alloc: jax.Array  # [Q,R] proportion allocated (zeros when off)
+    q_alloc_has_sc: jax.Array  # [Q] Go nil-scalar-map parity bit
+    paused_at: jax.Array  # task row the solve paused on (host-only), or -1
+
+
+def _lex_argmin(mask, *keys):
+    """Index of the mask=True element minimizing keys lexicographically;
+    first index wins ties (ties cannot survive a unique final key).
+    Returns (index, any) — index is garbage when any is False."""
+    m = mask
+    for k in keys:
+        if jnp.issubdtype(k.dtype, jnp.floating):
+            sentinel = jnp.asarray(jnp.inf, k.dtype)
+        else:
+            sentinel = jnp.iinfo(k.dtype).max
+        kmin = jnp.min(jnp.where(m, k, sentinel))
+        m = m & (k == kmin)
+    return jnp.argmax(m), jnp.any(mask)
+
+
+def _le_eps(req, pool, eps):
+    """Vectorized Resource.less_equal over the node axis
+    (resource_info.go:255-278): per-dimension l < r + eps."""
+    return jnp.all(req[None, :] < pool + eps[None, :], axis=1)
+
+
+def _share_rows(alloc, denom, dims):
+    """Vectorized api.helpers.share over rows: max over masked dims of
+    share(alloc, denom) with 0/0 -> 0, x/0 -> 1 (helpers.go:43-60,
+    drf.go:161-171, proportion.go:211-223)."""
+    safe = jnp.where(denom == 0, 1.0, denom)
+    s = jnp.where(denom == 0, jnp.where(alloc == 0, 0.0, 1.0), alloc / safe)
+    s = jnp.where(dims, s, -jnp.inf)
+    return jnp.maximum(jnp.max(s, axis=-1), 0.0)
+
+
+@partial(jax.jit, static_argnames=("enable_drf", "enable_proportion"))
+def init_state(a: dict, enable_drf: bool = False, enable_proportion: bool = False) -> SolveState:
+    """Fresh solve state from an encoded snapshot (see ops.encode)."""
+    T = a["task_req"].shape[0]
+    J = a["job_min"].shape[0]
+    Q = a["queue_rank"].shape[0]
+    R = a["task_req"].shape[1]
+    fdtype = a["task_req"].dtype
+    return SolveState(
+        it=jnp.int32(0),
+        step=jnp.int32(0),
+        cur=jnp.int32(-1),
+        ptr=a["job_start"],
+        assigned_node=jnp.full(T, -1, jnp.int32),
+        assigned_kind=jnp.zeros(T, jnp.int32),
+        assign_pos=jnp.full(T, -1, jnp.int32),
+        idle=a["node_idle"],
+        rel=a["node_rel"],
+        used=a["node_used"],
+        ntasks=a["node_ntasks"],
+        nports=a["node_ports"],
+        ready_cnt=a["job_ready0"],
+        job_active=a["job_valid"],
+        q_dropped=jnp.zeros(Q, bool),
+        job_alloc=a["job_alloc0"] if enable_drf else jnp.zeros((J, R), fdtype),
+        q_alloc=a["q_alloc0"] if enable_proportion else jnp.zeros((Q, R), fdtype),
+        q_alloc_has_sc=a["q_alloc_has_sc0"] if enable_proportion else jnp.zeros(Q, bool),
+        paused_at=jnp.int32(-1),
+    )
+
+
+def solve_allocate_step(
+    a: dict,
+    state: SolveState | None = None,
+    enable_drf: bool = False,
+    enable_proportion: bool = False,
+) -> SolveState:
+    """The full allocate solve; call through `solve_allocate` (jitted).
+
+    Runs until every job is retired or, when the encoder flagged host-only
+    tasks (`a["task_host_only"]` has any True), until such a task reaches
+    the head of its job — then returns with `paused_at` set so the action
+    can serial-step it and resume (`state=` carries everything forward).
+    """
+    T = a["task_req"].shape[0]
+    J = a["job_min"].shape[0]
+    Q = a["queue_rank"].shape[0]
+
+    task_req = a["task_req"]
+    task_res = a["task_res"]
+    task_gid = a["task_gid"]
+    task_has_sc = a["task_has_sc"]
+    task_res_has_sc = a["task_res_has_sc"]
+    task_ports = a["task_ports"]
+    task_host_only = a["task_host_only"]
+    node_alloc = a["node_alloc"]
+    node_ok = a["node_ok"] & a["node_valid"]
+    node_max_tasks = a["node_max_tasks"]
+    node_idle_has_sc = a["node_idle_has_sc"]
+    node_rel_has_sc = a["node_rel_has_sc"]
+    node_gid = a["node_gid"]
+    compat = a["compat"]
+    aff_sc = a["aff_sc"]
+    pod_sc = a["pod_sc"]  # [GT, N] InterPodAffinity (zeros when inactive)
+    job_end = a["job_end"]
+    job_min = a["job_min"]
+    job_prio = a["job_prio"]
+    job_rank = a["job_rank"]
+    job_queue = a["job_queue"]
+    queue_rank = a["queue_rank"]
+    eps = a["eps"]
+    fdtype = task_req.dtype
+    w_least = jnp.asarray(a["w_least"], fdtype)
+    w_balanced = jnp.asarray(a["w_balanced"], fdtype)
+    w_aff = jnp.asarray(a["w_aff"], fdtype)
+    w_podaff = jnp.asarray(a["w_podaff"], fdtype)
+    if enable_drf:
+        drf_total = a["drf_total"]
+        drf_dims = a["drf_dims"]
+    if enable_proportion:
+        q_deserved = a["q_deserved"]
+        q_dims = a["q_dims"]
+        eps_row = eps[None, :]
+
+    # One iteration per task pop, job drop, queue drop, plus one paused
+    # iteration per host-only task in the segmented hybrid.
+    max_iter = jnp.int32(T + J + Q + 1) + jnp.sum(task_host_only).astype(jnp.int32)
+
+    if state is None:
+        state = init_state(a, enable_drf=enable_drf, enable_proportion=enable_proportion)
+    state = state._replace(paused_at=jnp.int32(-1))
+
+    def cond(s: SolveState):
+        return (
+            ((s.cur >= 0) | jnp.any(s.job_active))
+            & (s.it < max_iter)
+            & (s.paused_at < 0)
+        )
+
+    def body(s: SolveState) -> SolveState:
+        # -- queue + job selection (only bites when no current job) ---------
+        need_sel = s.cur < 0
+        q_has = (
+            jnp.zeros(Q, jnp.int32).at[job_queue].max(s.job_active.astype(jnp.int32))
+            > 0
+        ) & ~s.q_dropped
+        if enable_proportion:
+            q_share = _share_rows(s.q_alloc, q_deserved, q_dims)
+            qsel, q_any = _lex_argmin(q_has, q_share, queue_rank)
+        else:
+            qsel, q_any = _lex_argmin(q_has, queue_rank)
+        qsel = qsel.astype(jnp.int32)
+
+        if enable_proportion:
+            # Overused gate: deserved.LessEqual(allocated) with the Go
+            # nil-scalar-map branch (proportion.go:188-199 +
+            # resource_info.go:255-278).
+            d_row = q_deserved[qsel]
+            a_row = s.q_alloc[qsel]
+            dim_ok = (d_row < a_row) | (jnp.abs(a_row - d_row) < eps)
+            sc_ok = jnp.concatenate(
+                [
+                    jnp.ones(2, bool),
+                    jnp.full(dim_ok.shape[0] - 2, s.q_alloc_has_sc[qsel]),
+                ]
+            )
+            dim_ok = dim_ok & sc_ok
+            overused = jnp.all(jnp.where(q_dims[qsel], dim_ok, True))
+        else:
+            overused = jnp.bool_(False)
+
+        drop_q = need_sel & q_any & overused
+
+        ready_bit = (s.ready_cnt >= job_min).astype(jnp.int32)
+        jmask = s.job_active & (job_queue == qsel)
+        jkeys = [-job_prio, ready_bit]
+        if enable_drf:
+            jkeys.append(_share_rows(s.job_alloc, drf_total[None, :], drf_dims[None, :]))
+        jkeys.append(job_rank)
+        jsel, j_any = _lex_argmin(jmask, *jkeys)
+
+        sel_ok = q_any & ~overused & j_any
+        cur = jnp.where(
+            need_sel, jnp.where(sel_ok, jsel.astype(jnp.int32), -1), s.cur
+        )
+
+        # Dropping an overused queue retires all its jobs for this cycle
+        # (the serial heap drains the queue's remaining entries the same
+        # way — shares only grow during allocate, so overused is final).
+        job_active = jnp.where(
+            drop_q, s.job_active & (job_queue != qsel), s.job_active
+        )
+        q_dropped = s.q_dropped.at[qsel].set(drop_q | s.q_dropped[qsel])
+
+        # -- pop the current job's next pending task (O(1) pointer) ---------
+        cur_c = jnp.maximum(cur, 0)
+        t = s.ptr[cur_c]
+        t_any = (cur >= 0) & (t < job_end[cur_c])
+        t = jnp.minimum(t, T - 1)
+        drop = (cur >= 0) & ~t_any  # tasks exhausted -> job leaves the heap
+        pause = t_any & task_host_only[t]  # hybrid: host handles this task
+        proc = t_any & ~pause
+
+        # -- feasibility over the node axis (HOT LOOP #1, vectorized) -------
+        req = task_req[t]
+        fits_idle = _le_eps(req, s.idle, eps) & ~(task_has_sc[t] & ~node_idle_has_sc)
+        fits_rel = _le_eps(req, s.rel, eps) & ~(task_has_sc[t] & ~node_rel_has_sc)
+        static_ok = node_ok & compat[task_gid[t], node_gid]
+        room = s.ntasks < node_max_tasks
+        port_ok = ~jnp.any(task_ports[t][None, :] & s.nports, axis=1)
+        cand = static_ok & room & port_ok & (fits_idle | fits_rel)
+        any_cand = jnp.any(cand)
+        abandon = proc & ~any_cand  # serial `break` without re-push
+
+        # -- score (HOT LOOP #2, vectorized) + deterministic best node ------
+        res = task_res[t]
+        req_cpu = s.used[:, 0] + res[0]
+        req_mem = s.used[:, 1] + res[1]
+        cap_cpu = node_alloc[:, 0]
+        cap_mem = node_alloc[:, 1]
+
+        def least_dim(rq, cp):
+            safe = jnp.where(cp == 0, 1.0, cp)
+            sc = jnp.floor((cp - rq) * MAX_PRIORITY / safe).astype(jnp.int32)
+            return jnp.where((cp == 0) | (rq > cp), 0, sc)
+
+        least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
+        cpu_f = jnp.where(cap_cpu != 0, req_cpu / jnp.where(cap_cpu == 0, 1.0, cap_cpu), 1.0)
+        mem_f = jnp.where(cap_mem != 0, req_mem / jnp.where(cap_mem == 0, 1.0, cap_mem), 1.0)
+        balanced = jnp.where(
+            (cpu_f >= 1.0) | (mem_f >= 1.0),
+            0,
+            (MAX_PRIORITY - jnp.abs(cpu_f - mem_f) * MAX_PRIORITY).astype(jnp.int32),
+        )
+        score = (
+            least.astype(fdtype) * w_least
+            + balanced.astype(fdtype) * w_balanced
+            + aff_sc[task_gid[t], node_gid] * w_aff
+            + pod_sc[task_gid[t]] * w_podaff
+        )
+        nb = jnp.argmax(jnp.where(cand, score, -jnp.inf)).astype(jnp.int32)
+
+        assign = proc & any_cand
+        do_alloc = assign & fits_idle[nb]
+        do_pipe = assign & ~fits_idle[nb]  # predicate guarantees fits_rel
+
+        # -- apply the assignment (node_info.go:108-136 accounting) ---------
+        zero_row = jnp.zeros_like(res)
+        idle = s.idle.at[nb].add(jnp.where(do_alloc, -res, zero_row))
+        rel = s.rel.at[nb].add(jnp.where(do_pipe, -res, zero_row))
+        used = s.used.at[nb].add(jnp.where(assign, res, zero_row))
+        ntasks = s.ntasks.at[nb].add(jnp.where(assign, 1, 0))
+        nports = s.nports.at[nb].set(s.nports[nb] | (task_ports[t] & assign))
+        ready_cnt = s.ready_cnt.at[cur_c].add(jnp.where(do_alloc, 1, 0))
+        ptr = s.ptr.at[cur_c].add(jnp.where(proc, 1, 0))
+        assigned_node = s.assigned_node.at[t].set(
+            jnp.where(assign, nb, s.assigned_node[t])
+        )
+        kind = jnp.where(do_alloc, KIND_ALLOCATED, jnp.where(do_pipe, KIND_PIPELINED, 0))
+        assigned_kind = s.assigned_kind.at[t].set(
+            jnp.where(assign, kind, s.assigned_kind[t])
+        )
+        assign_pos = s.assign_pos.at[t].set(
+            jnp.where(assign, s.step, s.assign_pos[t])
+        )
+
+        # -- drf / proportion session-event bookkeeping (drf.go:135-154,
+        # proportion.go:202-223: allocated grows on allocate AND pipeline) --
+        add_row = jnp.where(assign, task_res[t], zero_row)
+        job_alloc = s.job_alloc.at[cur_c].add(add_row) if enable_drf else s.job_alloc
+        if enable_proportion:
+            qcur = job_queue[cur_c]
+            q_alloc = s.q_alloc.at[qcur].add(add_row)
+            q_alloc_has_sc = s.q_alloc_has_sc.at[qcur].set(
+                s.q_alloc_has_sc[qcur] | (assign & task_res_has_sc[t])
+            )
+        else:
+            q_alloc = s.q_alloc
+            q_alloc_has_sc = s.q_alloc_has_sc
+
+        # -- gang barrier / job lifecycle (allocate.go:117-119,182-185) -----
+        job_active = job_active.at[cur_c].set(
+            jnp.where(drop | abandon, False, job_active[cur_c])
+        )
+        ready_now = ready_cnt[cur_c] >= job_min[cur_c]
+        cur_next = jnp.where(drop | abandon | (proc & ready_now), -1, cur)
+
+        return SolveState(
+            it=s.it + 1,
+            step=s.step + assign.astype(jnp.int32),
+            cur=cur_next,
+            ptr=ptr,
+            assigned_node=assigned_node,
+            assigned_kind=assigned_kind,
+            assign_pos=assign_pos,
+            idle=idle,
+            rel=rel,
+            used=used,
+            ntasks=ntasks,
+            nports=nports,
+            ready_cnt=ready_cnt,
+            job_active=job_active,
+            q_dropped=q_dropped,
+            job_alloc=job_alloc,
+            q_alloc=q_alloc,
+            q_alloc_has_sc=q_alloc_has_sc,
+            paused_at=jnp.where(pause, t, jnp.int32(-1)),
+        )
+
+    return lax.while_loop(cond, body, state)
+
+
+def result_of(state: SolveState) -> SolveResult:
+    return SolveResult(
+        assigned_node=state.assigned_node,
+        assigned_kind=state.assigned_kind,
+        assign_pos=state.assign_pos,
+        ready_cnt=state.ready_cnt,
+        n_assigned=state.step,
+    )
+
+
+@partial(jax.jit, static_argnames=("enable_drf", "enable_proportion"))
+def _solve_fresh(a: dict, enable_drf: bool, enable_proportion: bool) -> SolveState:
+    return solve_allocate_step(
+        a, None, enable_drf=enable_drf, enable_proportion=enable_proportion
+    )
+
+
+@partial(jax.jit, static_argnames=("enable_drf", "enable_proportion"))
+def _solve_resume(
+    a: dict, state: SolveState, enable_drf: bool, enable_proportion: bool
+) -> SolveState:
+    return solve_allocate_step(
+        a, state, enable_drf=enable_drf, enable_proportion=enable_proportion
+    )
+
+
+def solve_allocate(
+    a: dict,
+    state: SolveState | None = None,
+    enable_drf: bool = False,
+    enable_proportion: bool = False,
+) -> SolveResult:
+    """One-shot jitted solve returning just the assignment result (ignores
+    pause; callers with host-only tasks drive the segmented loop through
+    `solve_allocate_state`)."""
+    return result_of(solve_allocate_state(a, state, enable_drf, enable_proportion))
+
+
+def solve_allocate_state(
+    a: dict,
+    state: SolveState | None = None,
+    enable_drf: bool = False,
+    enable_proportion: bool = False,
+) -> SolveState:
+    if state is None:
+        return _solve_fresh(a, enable_drf, enable_proportion)
+    return _solve_resume(a, state, enable_drf, enable_proportion)

@@ -1,0 +1,36 @@
+"""Multi-chip scaling for the solve kernels.
+
+The reference's only scale axis is a 16-goroutine fan-out per task
+(reference util/scheduler_helper.go:34-109) inside one process; the
+communication fabric is the Kubernetes API server (SURVEY.md section
+2.7). TPU-native, the scale axis is the **node dimension of the cluster
+snapshot sharded over a `jax.sharding.Mesh`**: every per-node block of
+the solve (feasibility masks, score rows, capacity updates) lives on the
+shard that owns those nodes, and XLA's GSPMD partitioner inserts the
+collectives (all-reduce argmax for best-node selection, all-gathers for
+the scattered capacity updates) over ICI — no hand-written NCCL/MPI
+equivalent, per the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA place collectives.
+
+`sharded_solve_allocate(arrays, mesh)` is the multi-chip twin of
+`ops.solve_allocate`; blockwise node-axis scaling means a 5k-node
+snapshot occupies 5k/n_devices rows per chip.
+"""
+
+from kube_batch_tpu.parallel.sharded import (
+    NODE_AXIS_ARRAYS,
+    ShardedSolver,
+    make_mesh,
+    node_shardings,
+    sharded_solve_allocate,
+    state_shardings,
+)
+
+__all__ = [
+    "NODE_AXIS_ARRAYS",
+    "ShardedSolver",
+    "make_mesh",
+    "node_shardings",
+    "sharded_solve_allocate",
+    "state_shardings",
+]

@@ -1,0 +1,111 @@
+"""drf plugin: dominant-resource fairness across jobs
+(reference pkg/scheduler/plugins/drf/drf.go:29-171)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.helpers import share
+from kube_batch_tpu.api.job_info import JobInfo, TaskInfo
+from kube_batch_tpu.api.resource_info import Resource
+from kube_batch_tpu.api.types import allocated_status
+from kube_batch_tpu.framework.arguments import Arguments
+from kube_batch_tpu.framework.event import Event, EventHandler
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework.session import Session
+
+SHARE_DELTA = 1e-6  # drf.go:29
+
+
+class _DrfAttr:
+    __slots__ = ("share", "allocated")
+
+    def __init__(self) -> None:
+        self.share = 0.0
+        self.allocated = Resource.empty()
+
+
+class DrfPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.total_resource = Resource.empty()
+        self.job_attrs: dict[str, _DrfAttr] = {}
+
+    @property
+    def name(self) -> str:
+        return "drf"
+
+    def _calculate_share(self, allocated: Resource) -> float:
+        """share = max over resources of allocated/total (drf.go:161-171)."""
+        res = 0.0
+        for rn in self.total_resource.resource_names():
+            s = share(allocated.get(rn), self.total_resource.get(rn))
+            if s > res:
+                res = s
+        return res
+
+    def _update_share(self, attr: _DrfAttr) -> None:
+        attr.share = self._calculate_share(attr.allocated)
+
+    def on_session_open(self, ssn: Session) -> None:
+        # Session precompute: totals + per-job allocated (drf.go:60-83).
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+        for job in ssn.jobs.values():
+            attr = _DrfAttr()
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+            self._update_share(attr)
+            self.job_attrs[job.uid] = attr
+
+        def preemptable_fn(preemptor: TaskInfo, preemptees: list[TaskInfo]) -> list[TaskInfo]:
+            """Victim is evictable only if the preemptor's post-allocation
+            share stays below (or within epsilon of) the victim's
+            post-eviction share (drf.go:85-112)."""
+            victims: list[TaskInfo] = []
+            latt = self.job_attrs[preemptor.job]
+            lalloc = latt.allocated.clone().add(preemptor.resreq)
+            ls = self._calculate_share(lalloc)
+            allocations: dict[str, Resource] = {}
+            for preemptee in preemptees:
+                if preemptee.job not in allocations:
+                    allocations[preemptee.job] = self.job_attrs[preemptee.job].allocated.clone()
+                ralloc = allocations[preemptee.job].sub(preemptee.resreq)
+                rs = self._calculate_share(ralloc)
+                if ls < rs or abs(ls - rs) <= SHARE_DELTA:
+                    victims.append(preemptee)
+            return victims
+
+        ssn.add_preemptable_fn(self.name, preemptable_fn)
+
+        def job_order_fn(l: JobInfo, r: JobInfo) -> int:
+            """Lower share schedules first (drf.go:114-132)."""
+            ls = self.job_attrs[l.uid].share
+            rs = self.job_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_job_order_fn(self.name, job_order_fn)
+
+        def on_allocate(event: Event) -> None:
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event: Event) -> None:
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def on_session_close(self, ssn: Session) -> None:
+        self.total_resource = Resource.empty()
+        self.job_attrs = {}
+
+
+def new(arguments: Arguments) -> Plugin:
+    return DrfPlugin(arguments)

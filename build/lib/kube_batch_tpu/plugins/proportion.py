@@ -1,0 +1,163 @@
+"""proportion plugin: queue-level weighted fair share via iterative
+water-filling (reference pkg/scheduler/plugins/proportion/proportion.go:101-223)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.helpers import min_resource, share
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.resource_info import Resource
+from kube_batch_tpu.api.types import TaskStatus, allocated_status
+from kube_batch_tpu.framework.arguments import Arguments
+from kube_batch_tpu.framework.event import Event, EventHandler
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework.session import Session
+
+
+class _QueueAttr:
+    __slots__ = ("queue_id", "name", "weight", "share", "deserved", "allocated", "request")
+
+    def __init__(self, queue_id: str, name: str, weight: int) -> None:
+        self.queue_id = queue_id
+        self.name = name
+        self.weight = weight
+        self.share = 0.0
+        self.deserved = Resource.empty()
+        self.allocated = Resource.empty()
+        self.request = Resource.empty()
+
+
+class ProportionPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.total_resource = Resource.empty()
+        self.queue_attrs: dict[str, _QueueAttr] = {}
+
+    @property
+    def name(self) -> str:
+        return "proportion"
+
+    def _update_share(self, attr: _QueueAttr) -> None:
+        """share = max over deserved dimensions of allocated/deserved
+        (proportion.go:211-223)."""
+        res = 0.0
+        for rn in attr.deserved.resource_names():
+            s = share(attr.allocated.get(rn), attr.deserved.get(rn))
+            if s > res:
+                res = s
+        attr.share = res
+
+    def on_session_open(self, ssn: Session) -> None:
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        # Build queue attributes from jobs (proportion.go:66-99).
+        for job in ssn.jobs.values():
+            if job.queue not in self.queue_attrs:
+                queue = ssn.queues.get(job.queue)
+                if queue is None:
+                    continue
+                self.queue_attrs[job.queue] = _QueueAttr(
+                    queue_id=queue.name, name=queue.name, weight=queue.weight
+                )
+            attr = self.queue_attrs[job.queue]
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+                        attr.request.add(t.resreq)
+                elif status == TaskStatus.PENDING:
+                    for t in tasks.values():
+                        attr.request.add(t.resreq)
+
+        # Iterative water-filling of deserved by weight until remaining
+        # is exhausted or every queue's request is met (proportion.go:101-144).
+        remaining = self.total_resource.clone()
+        met: set[str] = set()
+        while True:
+            total_weight = sum(
+                attr.weight
+                for attr in self.queue_attrs.values()
+                if attr.queue_id not in met
+            )
+            if total_weight == 0:
+                break
+            deserved_this_round = Resource.empty()
+            for attr in self.queue_attrs.values():
+                if attr.queue_id in met:
+                    continue
+                old_deserved = attr.deserved.clone()
+                attr.deserved.add(remaining.clone().multi(attr.weight / total_weight))
+                if not attr.deserved.less_equal(attr.request):
+                    attr.deserved = min_resource(attr.deserved, attr.request)
+                    met.add(attr.queue_id)
+                self._update_share(attr)
+                deserved_this_round.add(attr.deserved.clone().sub(old_deserved))
+            remaining.sub(deserved_this_round)
+            if remaining.is_empty():
+                break
+
+        def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
+            """Lower share first (proportion.go:146-159)."""
+            la = self.queue_attrs.get(l.name)
+            ra = self.queue_attrs.get(r.name)
+            ls = la.share if la else 0.0
+            rs = ra.share if ra else 0.0
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_queue_order_fn(self.name, queue_order_fn)
+
+        def reclaimable_fn(reclaimer: TaskInfo, reclaimees: list[TaskInfo]) -> list[TaskInfo]:
+            """Victim OK while its queue stays at or above deserved
+            (proportion.go:161-186)."""
+            victims: list[TaskInfo] = []
+            allocations: dict[str, Resource] = {}
+            for reclaimee in reclaimees:
+                job = ssn.jobs[reclaimee.job]
+                attr = self.queue_attrs[job.queue]
+                if job.queue not in allocations:
+                    allocations[job.queue] = attr.allocated.clone()
+                allocated = allocations[job.queue]
+                if allocated.less(reclaimee.resreq):
+                    continue
+                allocated.sub(reclaimee.resreq)
+                if attr.deserved.less_equal(allocated):
+                    victims.append(reclaimee)
+            return victims
+
+        ssn.add_reclaimable_fn(self.name, reclaimable_fn)
+
+        def overused_fn(queue: QueueInfo) -> bool:
+            """deserved <= allocated (proportion.go:188-199)."""
+            attr = self.queue_attrs.get(queue.name)
+            if attr is None:
+                return False
+            return attr.deserved.less_equal(attr.allocated)
+
+        ssn.add_overused_fn(self.name, overused_fn)
+
+        def on_allocate(event: Event) -> None:
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event: Event) -> None:
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def on_session_close(self, ssn: Session) -> None:
+        self.total_resource = Resource.empty()
+        self.queue_attrs = {}
+
+
+def new(arguments: Arguments) -> Plugin:
+    return ProportionPlugin(arguments)

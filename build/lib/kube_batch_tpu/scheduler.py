@@ -1,0 +1,127 @@
+"""L6: the scheduler loop (reference pkg/scheduler/scheduler.go:35-102).
+
+``Scheduler`` owns a cache and drives the session pipeline on a fixed
+period: every cycle it (re-)loads the scheduler configuration, opens a
+session over a fresh ``cache.snapshot()``, runs the configured actions
+in order, and records per-action and end-to-end latency — the metric
+families the reference emits from the same spot
+(scheduler.go:88-102).
+
+Divergences from the reference, by design:
+
+- the conf file is re-read **every cycle** (the reference loads it once
+  at startup, scheduler.go:63-85); a conf push takes effect on the next
+  cycle without a restart, and a broken conf falls back to the previous
+  good one rather than killing the loop;
+- the default action pipeline is ``enqueue, allocate, backfill``: the
+  reference's ``allocate, backfill`` default (util.go:31-42) relies on
+  Go's zero-value PodGroup phase ("") passing allocate's Pending gate
+  (allocate.go:52); our object model defaults the phase to Pending, so
+  the enqueue action (enqueue.go:66-119) owns that gate explicitly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import kube_batch_tpu.actions  # noqa: F401  (registers the action pipeline)
+import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
+from kube_batch_tpu import log, metrics
+from kube_batch_tpu.conf import load_scheduler_conf, read_scheduler_conf
+from kube_batch_tpu.framework import close_session, open_session
+
+DEFAULT_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+class Scheduler:
+    """reference scheduler.go:35-61."""
+
+    def __init__(
+        self,
+        cache,
+        scheduler_conf: Optional[str] = None,
+        schedule_period: float = 1.0,
+    ) -> None:
+        self.cache = cache
+        self.scheduler_conf = scheduler_conf  # path; None -> default conf
+        self.schedule_period = schedule_period
+        self.actions = []
+        self.plugins = []
+        self.action_arguments: dict[str, dict[str, str]] = {}
+        self._conf_cache: Optional[str] = None
+        self._load_conf()
+
+    def _load_conf(self) -> None:
+        """Load (or re-load) the conf; on failure keep the last good one
+        (reference scheduler.go:69-85 falls back to the default)."""
+        conf_str = DEFAULT_SCHEDULER_CONF
+        if self.scheduler_conf:
+            try:
+                conf_str = read_scheduler_conf(self.scheduler_conf)
+            except OSError as e:
+                log.errorf(
+                    "Failed to read scheduler configuration %r, using %s: %s",
+                    self.scheduler_conf,
+                    "previous" if self._conf_cache else "default",
+                    e,
+                )
+                conf_str = self._conf_cache or DEFAULT_SCHEDULER_CONF
+        if conf_str == self._conf_cache:
+            return
+        try:
+            self.actions, self.plugins, self.action_arguments = load_scheduler_conf(
+                conf_str
+            )
+            self._conf_cache = conf_str
+        except Exception as e:  # noqa: BLE001 - bad conf must not kill the loop
+            if self._conf_cache is None:
+                raise
+            log.errorf("Failed to load scheduler configuration, keeping previous: %s", e)
+
+    def run(self, stop: threading.Event) -> None:
+        """Start the cache and loop run_once until stopped
+        (reference scheduler.go:63-86)."""
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        while not stop.is_set():
+            start = time.perf_counter()
+            try:
+                self.run_once()
+            except Exception as e:  # noqa: BLE001 - a bad cycle must not kill the loop
+                log.errorf("scheduling cycle failed: %s", e)
+            elapsed = time.perf_counter() - start
+            stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def run_once(self) -> None:
+        """One scheduling cycle (reference scheduler.go:88-102)."""
+        log.V(4).infof("Start scheduling ...")
+        cycle_start = time.perf_counter()
+        self._load_conf()
+
+        ssn = open_session(self.cache, self.plugins, self.action_arguments)
+        try:
+            for action in self.actions:
+                action_start = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration(
+                    action.name, time.perf_counter() - action_start
+                )
+        finally:
+            close_session(ssn)
+            metrics.update_e2e_duration(time.perf_counter() - cycle_start)
+            metrics.schedule_attempts.inc()
+            log.V(4).infof("End scheduling ...")

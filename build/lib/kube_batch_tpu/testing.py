@@ -1,0 +1,378 @@
+"""Builders shared by unit tests, action tests, and the bench harness
+(reference pkg/scheduler/api/test_utils.go and pkg/scheduler/util/test_utils.go).
+"""
+
+from __future__ import annotations
+
+import queue
+import re
+import threading
+from typing import Optional, Union
+
+from kube_batch_tpu.apis.types import (
+    GROUP_NAME_ANNOTATION_KEY,
+    Container,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    Queue,
+    QueueSpec,
+)
+from kube_batch_tpu.api.job_info import TaskInfo
+from kube_batch_tpu.api.resource_info import Resource
+
+_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,  # milli (cpu)
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+}
+
+
+def parse_quantity(q: Union[str, int, float]) -> float:
+    """Parse a Kubernetes-style quantity string ("100m", "1G", "2Gi") into a
+    float in base units (cores for cpu, bytes for memory)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    m = _QUANTITY_RE.match(q.strip())
+    if not m:
+        raise ValueError(f"cannot parse quantity {q!r}")
+    value, suffix = m.groups()
+    if suffix not in _SUFFIX:
+        raise ValueError(f"unknown quantity suffix {suffix!r} in {q!r}")
+    return float(value) * _SUFFIX[suffix]
+
+
+def build_resource_list(
+    cpu: Union[str, float] = 0,
+    memory: Union[str, float] = 0,
+    pods: int = 0,
+    **scalars: Union[str, float],
+) -> dict[str, float]:
+    """Resource list dict from k8s-style quantity strings. Scalar kwargs
+    translate double-underscores: ``nvidia__com__gpu=2`` becomes
+    ``nvidia.com/gpu: 2`` (first ``__`` -> ``.``, second -> ``/``); or pass
+    a pre-built dict via build_resource_list(**{"nvidia.com/gpu": 2})."""
+    rl: dict[str, float] = {}
+    if cpu:
+        rl["cpu"] = parse_quantity(cpu)
+    if memory:
+        rl["memory"] = parse_quantity(memory)
+    if pods:
+        rl["pods"] = float(pods)
+    for name, q in scalars.items():
+        if "__" in name:
+            # domain__suffix__resource -> domain.suffix/resource
+            parts = name.split("__")
+            name = ".".join(parts[:-1]) + "/" + parts[-1]
+        rl[name] = parse_quantity(q)
+    return rl
+
+
+def build_pod(
+    namespace: str = "default",
+    name: str = "pod",
+    node_name: str = "",
+    phase: PodPhase = PodPhase.PENDING,
+    req: Optional[dict[str, float]] = None,
+    group_name: str = "",
+    labels: Optional[dict[str, str]] = None,
+    priority: Optional[int] = None,
+    node_selector: Optional[dict[str, str]] = None,
+    scheduler_name: str = "kube-batch-tpu",
+    volumes: Optional[list[str]] = None,
+) -> Pod:
+    """reference api/test_utils.go buildPod."""
+    annotations = {}
+    if group_name:
+        annotations[GROUP_NAME_ANNOTATION_KEY] = group_name
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=f"{namespace}-{name}",
+            labels=labels or {},
+            annotations=annotations,
+        ),
+        phase=phase,
+        containers=[Container(requests=dict(req or {}))],
+        node_name=node_name,
+        node_selector=node_selector or {},
+        priority=priority,
+        scheduler_name=scheduler_name,
+        volumes=list(volumes or []),
+    )
+
+
+def build_node(
+    name: str,
+    alloc: Optional[dict[str, float]] = None,
+    labels: Optional[dict[str, str]] = None,
+    capacity: Optional[dict[str, float]] = None,
+) -> Node:
+    """reference api/test_utils.go buildNode."""
+    alloc = dict(alloc or {})
+    return Node(
+        metadata=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        allocatable=alloc,
+        capacity=dict(capacity) if capacity is not None else dict(alloc),
+    )
+
+
+def build_pod_group(
+    name: str,
+    namespace: str = "default",
+    queue: str = "default",
+    min_member: int = 1,
+    min_resources: Optional[dict[str, float]] = None,
+) -> PodGroup:
+    return PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=f"pg-{namespace}-{name}"),
+        spec=PodGroupSpec(min_member=min_member, queue=queue, min_resources=min_resources),
+    )
+
+
+def build_queue(name: str, weight: int = 1) -> Queue:
+    return Queue(metadata=ObjectMeta(name=name, uid=f"q-{name}"), spec=QueueSpec(weight=weight))
+
+
+def build_pv(
+    name: str,
+    capacity: Union[str, int, float] = "10Gi",
+    storage_class: str = "",
+    node_affinity: Optional[list] = None,
+):
+    from kube_batch_tpu.apis.types import PersistentVolume
+
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, uid=f"pv-{name}"),
+        capacity_storage=parse_quantity(capacity),
+        storage_class_name=storage_class,
+        node_affinity=list(node_affinity or []),
+    )
+
+
+def build_pvc(
+    name: str,
+    namespace: str = "default",
+    storage_class: str = "",
+    request: Union[str, int, float] = "1Gi",
+):
+    from kube_batch_tpu.apis.types import PersistentVolumeClaim
+
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=f"pvc-{namespace}-{name}"),
+        storage_class_name=storage_class,
+        request_storage=parse_quantity(request),
+    )
+
+
+def build_storage_class(name: str, mode: str = "Immediate"):
+    from kube_batch_tpu.apis.types import StorageClass, VolumeBindingMode
+
+    return StorageClass(
+        metadata=ObjectMeta(name=name, uid=f"sc-{name}"),
+        volume_binding_mode=VolumeBindingMode(mode),
+    )
+
+
+def build_task(
+    namespace: str = "default",
+    name: str = "task",
+    req: Optional[dict[str, float]] = None,
+    node_name: str = "",
+    phase: PodPhase = PodPhase.PENDING,
+    group_name: str = "",
+    priority: Optional[int] = None,
+) -> TaskInfo:
+    return TaskInfo(
+        build_pod(
+            namespace=namespace,
+            name=name,
+            node_name=node_name,
+            phase=phase,
+            req=req,
+            group_name=group_name,
+            priority=priority,
+        )
+    )
+
+
+def build_resource(cpu: Union[str, float] = 0, memory: Union[str, float] = 0, **scalars) -> Resource:
+    return Resource.from_resource_list(build_resource_list(cpu, memory, **scalars))
+
+
+class FakeBinder:
+    """Records binds instead of calling an API server; delivers one signal
+    per bind, like the reference's Go channel (util/test_utils.go:95-117) —
+    a latching Event would let a test waiting for N binds pass after one."""
+
+    def __init__(self) -> None:
+        self.binds: dict[str, str] = {}  # "ns/name" -> node
+        # SimpleQueue: same one-signal-per-bind contract, C-implemented so
+        # a 50k-bind bench run is not dominated by queue.Queue locking.
+        self.channel: "queue.SimpleQueue[str]" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        with self._lock:
+            self.binds[key] = hostname
+        self.channel.put(key)
+
+    def bind_many(self, pairs: list) -> None:
+        """Bulk form: one lock acquisition, same one-signal-per-bind
+        channel contract."""
+        keyed = [(f"{pod.namespace}/{pod.name}", hostname) for pod, hostname in pairs]
+        with self._lock:
+            self.binds.update(keyed)
+        for key, _ in keyed:
+            self.channel.put(key)
+
+
+class FakeEvictor:
+    """reference util/test_utils.go:120-140; one signal per evict."""
+
+    def __init__(self) -> None:
+        self.evicts: list[str] = []
+        self.channel: "queue.SimpleQueue[str]" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+
+    def evict(self, pod: Pod) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        with self._lock:
+            self.evicts.append(key)
+        self.channel.put(key)
+
+
+class FakeStatusUpdater:
+    """no-op (reference util/test_utils.go:143-153)."""
+
+    def update_pod_condition(self, pod: Pod, condition) -> None:
+        return None
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        return None
+
+
+class FakeVolumeBinder:
+    """no-op (reference util/test_utils.go:156-166)."""
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        return None
+
+    def bind_volumes(self, task) -> None:
+        return None
+
+
+def build_cluster(
+    pods: list[Pod],
+    nodes: list[Node],
+    pod_groups: Optional[list[PodGroup]] = None,
+    queues: Optional[list[Queue]] = None,
+):
+    """Wire pods/nodes/podgroups/queues into a ClusterInfo the way the
+    cache does (reference cache/event_handlers.go:43-88): tasks join jobs
+    via the group-name annotation (pods without one get a synthetic
+    single-member shadow job), bound/running tasks also land on their
+    node. Jobs whose PodGroup is Pending-phase get phase Inqueue so the
+    allocate action considers them (the enqueue action owns that gate in
+    a full pipeline)."""
+    from kube_batch_tpu.api.cluster_info import ClusterInfo
+    from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, get_job_id, job_key
+    from kube_batch_tpu.api.node_info import NodeInfo
+    from kube_batch_tpu.api.queue_info import QueueInfo
+    from kube_batch_tpu.apis.types import PodGroupPhase
+
+    cluster = ClusterInfo()
+    for node in nodes:
+        cluster.nodes[node.name] = NodeInfo(node)
+    for queue in queues or []:
+        cluster.queues[queue.name] = QueueInfo(queue)
+
+    for pg in pod_groups or []:
+        if pg.status.phase == PodGroupPhase.PENDING:
+            pg.status.phase = PodGroupPhase.INQUEUE
+        jid = job_key(pg.metadata.namespace, pg.name)
+        job = JobInfo(jid)
+        job.set_pod_group(pg)
+        cluster.jobs[jid] = job
+
+    for pod in pods:
+        task = TaskInfo(pod)
+        jid = get_job_id(pod) or f"{pod.namespace}/{pod.name}-shadow"
+        if jid not in cluster.jobs:
+            shadow = build_pod_group(
+                name=f"{pod.name}-shadow", namespace=pod.namespace, min_member=1
+            )
+            shadow.status.phase = PodGroupPhase.INQUEUE
+            job = JobInfo(jid)
+            job.set_pod_group(shadow)
+            cluster.jobs[jid] = job
+        task.job = jid
+        cluster.jobs[jid].add_task_info(task)
+        if task.node_name and task.node_name in cluster.nodes:
+            cluster.nodes[task.node_name].add_task(task)
+    return cluster
+
+
+class FakeCache:
+    """Session-facing cache with fake write-side, for action-level tests
+    (the pattern of reference actions/allocate/allocate_test.go:38-212:
+    real model, fake Binder/Evictor)."""
+
+    def __init__(
+        self,
+        cluster,
+        binder: Optional[FakeBinder] = None,
+        evictor: Optional[FakeEvictor] = None,
+        status_updater: Optional[FakeStatusUpdater] = None,
+        volume_binder: Optional[FakeVolumeBinder] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.binder = binder or FakeBinder()
+        self.evictor = evictor or FakeEvictor()
+        self.status_updater = status_updater or FakeStatusUpdater()
+        self.volume_binder = volume_binder or FakeVolumeBinder()
+
+    def snapshot(self):
+        from kube_batch_tpu.api.cluster_info import ClusterInfo
+
+        return ClusterInfo(
+            jobs={uid: job.clone() for uid, job in self.cluster.jobs.items()},
+            nodes={name: node.clone() for name, node in self.cluster.nodes.items()},
+            queues={name: q.clone() for name, q in self.cluster.queues.items()},
+        )
+
+    def bind(self, task, hostname: str) -> None:
+        self.binder.bind(task.pod, hostname)
+
+    def bind_many(self, pairs: list) -> None:
+        self.binder.bind_many([(task.pod, hostname) for task, hostname in pairs])
+
+    def evict(self, task, reason: str) -> None:
+        self.evictor.evict(task.pod)
+
+    def update_job_status(self, job):
+        self.status_updater.update_pod_group(job.pod_group)
+        return job
+
+    def record_job_status_event(self, job) -> None:
+        return None
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task) -> None:
+        self.volume_binder.bind_volumes(task)

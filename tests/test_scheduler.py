@@ -314,7 +314,7 @@ def test_example_confs_load_and_schedule(tmp_path):
     expected_actions = {
         "scheduler-conf.yaml": ["enqueue", "reclaim", "allocate", "backfill", "preempt"],
         "scheduler-conf-tpu.yaml": [
-            "enqueue", "xla_reclaim", "xla_allocate", "backfill", "xla_preempt",
+            "enqueue", "xla_reclaim", "xla_allocate", "xla_backfill", "xla_preempt",
         ],
     }
     for conf in ("scheduler-conf.yaml", "scheduler-conf-tpu.yaml"):

@@ -1,0 +1,296 @@
+"""xla_backfill ≡ backfill: the vectorized BestEffort scan's oracle.
+
+The serial backfill action is the reference implementation
+(backfill.go:41-76 semantics); these tests assert the group-dedup'd
+scan (actions/xla_backfill.py) places the same tasks on the same nodes
+in the same order across the predicate edges the scan models — node
+selectors, taints/tolerations, cordon, max-task pressure, host ports —
+and that pod-affinity tasks and out-of-envelope confs route through the
+serial chain."""
+
+import random
+
+import pytest
+
+from kube_batch_tpu import actions  # noqa: F401  (registers actions)
+from kube_batch_tpu import plugins  # noqa: F401  (registers plugins)
+from kube_batch_tpu.apis.types import (
+    Affinity,
+    PodAffinityTerm,
+    PodPhase,
+    Taint,
+    Toleration,
+)
+from kube_batch_tpu.conf import parse_scheduler_conf
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.testing import (
+    FakeCache,
+    build_cluster,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+TIERS = """
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def run_and_capture(action_name, cluster):
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(TIERS).tiers)
+    get_action(action_name).execute(ssn)
+    state = {}
+    for job in ssn.jobs.values():
+        for tasks in job.task_status_index.values():
+            for t in tasks.values():
+                state[t.uid] = (t.status, t.node_name)
+    node_tasks = {
+        name: sorted(n.tasks) for name, n in ssn.nodes.items()
+    }
+    close_session(ssn)
+    return state, node_tasks, dict(cache.binder.binds)
+
+
+def assert_equivalent(make_cluster):
+    s = run_and_capture("backfill", make_cluster())
+    x = run_and_capture("xla_backfill", make_cluster())
+    assert x == s
+    return s
+
+
+def _be_pod(name, **kw):
+    """BestEffort pod: zero requests (backfill's only clientele)."""
+    return build_pod(name=name, req=None, **kw)
+
+
+def test_places_best_effort_on_first_node():
+    pods = [_be_pod(f"be{i}", group_name="g") for i in range(4)]
+    nodes = [build_node(f"n{i}", alloc={"cpu": 1.0, "pods": 110}) for i in range(3)]
+    s = assert_equivalent(
+        lambda: build_cluster(
+            pods, nodes, [build_pod_group("g", min_member=1)], [build_queue("default")]
+        )
+    )
+    # every task landed, first-node-in-name-order semantics
+    state, node_tasks, _ = s
+    assert all(host == "n0" for _, host in state.values())
+
+
+def test_selector_and_taint_edges():
+    def mk():
+        pods = []
+        for i in range(6):
+            p = _be_pod(f"sel{i}", group_name="g", node_selector={"zone": "a"})
+            pods.append(p)
+        for i in range(6):
+            p = _be_pod(f"tol{i}", group_name="g")
+            p.tolerations.append(Toleration(key="dedicated", operator="Exists"))
+            pods.append(p)
+        plain = [_be_pod(f"plain{i}", group_name="g") for i in range(6)]
+        pods.extend(plain)
+        nodes = [
+            build_node("a0", alloc={"cpu": 1.0, "pods": 110}, labels={"zone": "a"}),
+            build_node("b0", alloc={"cpu": 1.0, "pods": 110}, labels={"zone": "b"}),
+            build_node("t0", alloc={"cpu": 1.0, "pods": 110}),
+        ]
+        nodes[2].taints.append(Taint(key="dedicated", effect="NoSchedule"))
+        cordoned = build_node("c0", alloc={"cpu": 1.0, "pods": 110})
+        cordoned.unschedulable = True
+        nodes.append(cordoned)
+        return build_cluster(
+            pods, nodes, [build_pod_group("g", min_member=1)], [build_queue("default")]
+        )
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    by_host = {}
+    for _, host in state.values():
+        by_host[host] = by_host.get(host, 0) + 1
+    assert by_host.get("c0", 0) == 0  # cordoned node untouched
+    # selector pods can only sit on a0; tolerating pods may use t0
+    assert all(host == "a0" for uid, (st, host) in state.items() if "sel" in uid)
+
+
+def test_max_task_pressure_spills_to_next_node():
+    def mk():
+        pods = [_be_pod(f"be{i}", group_name="g") for i in range(8)]
+        nodes = [
+            build_node("n0", alloc={"cpu": 1.0, "pods": 3}),
+            build_node("n1", alloc={"cpu": 1.0, "pods": 10}),
+        ]
+        return build_cluster(
+            pods, nodes, [build_pod_group("g", min_member=1)], [build_queue("default")]
+        )
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    assert len(node_tasks["n0"]) == 3 and len(node_tasks["n1"]) == 5
+
+
+def test_host_port_conflicts_spread():
+    def mk():
+        pods = []
+        for i in range(3):
+            p = _be_pod(f"port{i}", group_name="g")
+            p.containers[0].ports = [8080]
+            pods.append(p)
+        nodes = [build_node(f"n{i}", alloc={"cpu": 1.0, "pods": 110}) for i in range(4)]
+        return build_cluster(
+            pods, nodes, [build_pod_group("g", min_member=1)], [build_queue("default")]
+        )
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    hosts = [host for _, host in state.values()]
+    assert len(set(hosts)) == 3  # one port-8080 pod per node
+
+
+def test_resident_port_blocks_node():
+    def mk():
+        running = _be_pod("res", group_name="gr", node_name="n0", phase=PodPhase.RUNNING)
+        running.containers[0].ports = [9090]
+        newp = _be_pod("new", group_name="g")
+        newp.containers[0].ports = [9090]
+        nodes = [build_node("n0", alloc={"cpu": 1.0, "pods": 110}), build_node("n1", alloc={"cpu": 1.0, "pods": 110})]
+        return build_cluster(
+            [running, newp],
+            nodes,
+            [build_pod_group("g", min_member=1), build_pod_group("gr", min_member=1)],
+            [build_queue("default")],
+        )
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    assert state["default-new"][1] == "n1"
+
+
+def test_pod_affinity_tasks_step_serially():
+    def mk():
+        anchor = _be_pod(
+            "anchor", group_name="ga", node_name="n1", phase=PodPhase.RUNNING,
+            labels={"app": "db"},
+        )
+        follower = _be_pod("follower", group_name="g")
+        follower.affinity = Affinity(
+            pod_affinity_required=[
+                PodAffinityTerm(
+                    label_selector={"app": "db"},
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]
+        )
+        nodes = [
+            build_node("n0", alloc={"cpu": 1.0, "pods": 110}),
+            build_node("n1", alloc={"cpu": 1.0, "pods": 110}),
+        ]
+        return build_cluster(
+            [anchor, follower],
+            nodes,
+            [build_pod_group("g", min_member=1), build_pod_group("ga", min_member=1)],
+            [build_queue("default")],
+        )
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    assert state["default-follower"][1] == "n1"  # required affinity honored
+
+
+def test_skips_non_best_effort_and_pending_groups():
+    def mk():
+        pods = [
+            build_pod(name="heavy", req={"cpu": 1.0}, group_name="g"),
+            _be_pod("light", group_name="g"),
+            _be_pod("gated", group_name="pending-g"),
+        ]
+        nodes = [build_node("n0", alloc={"cpu": 4.0, "pods": 110})]
+        cluster = build_cluster(
+            pods,
+            nodes,
+            [build_pod_group("g", min_member=1), build_pod_group("pending-g", min_member=1)],
+            [build_queue("default")],
+        )
+        # keep pending-g in Pending phase (build_cluster promotes to Inqueue)
+        from kube_batch_tpu.apis.types import PodGroupPhase
+
+        cluster.jobs["default/pending-g"].pod_group.status.phase = PodGroupPhase.PENDING
+        return cluster
+
+    state, node_tasks, _ = assert_equivalent(mk)
+    assert state["default-light"][1] == "n0"
+    assert state["default-heavy"][1] == ""  # not backfill's business
+    assert state["default-gated"][1] == ""  # gated behind enqueue
+
+
+def test_randomized_parity_sweep():
+    zones = ["a", "b", "c"]
+
+    def mk(seed):
+        rng = random.Random(seed)
+        pods = []
+        for i in range(rng.randint(10, 60)):
+            kind = rng.random()
+            p = _be_pod(f"be{i}", group_name=f"g{i % 5}")
+            if kind < 0.25:
+                p.node_selector.update({"zone": rng.choice(zones)})
+            elif kind < 0.4:
+                p.tolerations.append(Toleration(key="dedicated", operator="Exists"))
+            elif kind < 0.5:
+                p.containers[0].ports = [rng.choice([80, 443, 8080])]
+            pods.append(p)
+        nodes = []
+        for i in range(rng.randint(3, 12)):
+            node = build_node(
+                f"n{i:02d}",
+                alloc={"cpu": 1.0, "pods": rng.choice([2, 4, 110])},
+                labels={"zone": rng.choice(zones)},
+            )
+            if rng.random() < 0.2:
+                node.taints.append(Taint(key="dedicated", effect="NoSchedule"))
+            if rng.random() < 0.1:
+                node.unschedulable = True
+            nodes.append(node)
+        return build_cluster(
+            pods,
+            nodes,
+            [build_pod_group(f"g{i}", min_member=1) for i in range(5)],
+            [build_queue("default")],
+        )
+
+    for seed in range(24):
+        assert_equivalent(lambda: mk(seed))
+
+
+def test_out_of_envelope_conf_falls_back_serial():
+    no_predicates = """
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+    tiers = parse_scheduler_conf(no_predicates).tiers
+
+    def run(action_name):
+        pods = [_be_pod(f"be{i}", group_name="g") for i in range(5)]
+        nodes = [build_node(f"n{i}", alloc={"cpu": 1.0, "pods": 110}) for i in range(2)]
+        cluster = build_cluster(
+            pods, nodes, [build_pod_group("g", min_member=1)], [build_queue("default")]
+        )
+        cache = FakeCache(cluster)
+        ssn = open_session(cache, tiers)
+        get_action(action_name).execute(ssn)
+        state = {
+            t.uid: (t.status, t.node_name)
+            for job in ssn.jobs.values()
+            for tasks in job.task_status_index.values()
+            for t in tasks.values()
+        }
+        close_session(ssn)
+        return state
+
+    assert run("xla_backfill") == run("backfill")
