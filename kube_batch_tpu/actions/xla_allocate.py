@@ -180,11 +180,13 @@ class XlaAllocateAction(Action):
             state = solve_fn(s)
 
         result = result_of(state)
+        # all three result vectors come off-device here: the transfer is
+        # part of the solve's device round-trip, not of the replay
         assign_pos = np.asarray(result.assign_pos)
-        t_solve = _time.perf_counter() - t0
-        t0 = _time.perf_counter()
         assigned_node = np.asarray(result.assigned_node)
         assigned_kind = np.asarray(result.assigned_kind)
+        t_solve = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
         replay.finish(np.asarray(result.ready_cnt))
         self.last_timings = {

@@ -48,13 +48,16 @@ class XlaBackfillAction(Action):
             BackfillAction().execute(ssn)
             return
 
-        from kube_batch_tpu.ops.encode import _task_ports, _task_signature
+        from kube_batch_tpu.ops.encode import (
+            _node_signature,
+            _task_ports,
+            _task_signature,
+            build_static_compat,
+            group_by_signature,
+        )
         from kube_batch_tpu.plugins.predicates import (
             check_node_condition,
-            check_node_selector,
-            check_node_unschedulable,
             check_pressure,
-            check_taints,
         )
         from kube_batch_tpu.utils import get_node_list
 
@@ -105,16 +108,11 @@ class XlaBackfillAction(Action):
                     label_keys.add(term.key)
                 for _, term in aff.node_affinity_preferred:
                     label_keys.add(term.key)
-        from kube_batch_tpu.ops.encode import _node_signature
-
         frozen_keys = frozenset(label_keys)
         node_ok = np.zeros(n, bool)
         max_tasks = np.zeros(n, np.int64)
         ntasks = np.zeros(n, np.int64)
         node_ports = np.zeros(n, np.int64)
-        node_gid = np.zeros(n, np.int32)
-        n_groups: dict[tuple, int] = {}
-        n_reps: list = []
         for i, node in enumerate(nodes):
             node_ok[i] = (
                 node.node is not None
@@ -129,34 +127,14 @@ class XlaBackfillAction(Action):
                         bit = port_bit.get(p)
                         if bit is not None:
                             node_ports[i] |= bit
-            sig = _node_signature(node, frozen_keys)
-            gid = n_groups.get(sig)
-            if gid is None:
-                gid = n_groups[sig] = len(n_reps)
-                n_reps.append(node)
-            node_gid[i] = gid
 
-        # -- task groups + (group x node-group) verdicts -------------------
-        t_groups: dict[tuple, int] = {}
-        t_reps: list = []
-        task_gid: list[int] = []
-        for t in work:
-            sig = _task_signature(t)
-            gid = t_groups.get(sig)
-            if gid is None:
-                gid = t_groups[sig] = len(t_reps)
-                t_reps.append(t)
-            task_gid.append(gid)
-        compat = np.zeros((len(t_reps), len(n_reps)), bool)
-        for gi, trep in enumerate(t_reps):
-            for gj, nrep in enumerate(n_reps):
-                if nrep.node is None:
-                    continue
-                compat[gi, gj] = (
-                    check_node_unschedulable(trep.pod, nrep.node)
-                    and check_node_selector(trep.pod, nrep.node)
-                    and check_taints(trep.pod, nrep.node)
-                )
+        # -- dedup groups + (group x node-group) verdicts (shared with
+        #    the encoder: ops/encode.py group_by_signature/build_static_compat)
+        node_gid, n_reps = group_by_signature(
+            nodes, lambda nd: _node_signature(nd, frozen_keys)
+        )
+        task_gid, t_reps = group_by_signature(work, _task_signature)
+        compat = build_static_compat(t_reps, n_reps)
 
         # -- the walk, serial order, live session mutations ---------------
         placed = 0

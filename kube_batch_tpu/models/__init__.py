@@ -272,7 +272,7 @@ def besteffort_mix(
     for j in range(n_jobs):
         name = f"be-{j:04d}"
         pgs.append(build_pod_group(name, min_member=1))
-        for t in range(min(20, n_pods - 20 * j)):
+        for t in range(20):
             pod = build_pod(name=f"{name}-t{t}", group_name=name)
             shape = rng.random()
             if shape < 0.3:

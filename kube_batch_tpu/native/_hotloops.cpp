@@ -29,7 +29,9 @@
 #include <Python.h>
 #include <structmember.h>
 
+#include <algorithm>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -45,6 +47,7 @@ const char* const kSlotNames[kNumSlots] = {
 constexpr int kUid = 0;
 constexpr int kNodeName = 6;
 constexpr int kStatus = 7;
+constexpr int kPriority = 8;
 constexpr int kVolumeReady = 9;
 constexpr int kPod = 10;
 
@@ -269,7 +272,7 @@ fail_ix:
   return nullptr;
 }
 
-/* ---- encode-side extractors ---------------------------------------------- */
+/* ---- Resource slot access (shared by collect_pending + extractors) ------- */
 
 constexpr int kSlotJob = 1;
 constexpr int kSlotResreq = 4;
@@ -304,6 +307,241 @@ int resolve_res_slots(PyTypeObject* tp, ResSlotCache* cache) {
   return 0;
 }
 
+/* Read resource.milli_cpu / resource.memory as doubles; -1 on error. */
+inline int res_cpu_mem(PyObject* res, const ResSlotCache& rc, double* cpu,
+                       double* mem) {
+  PyObject* c = get_slot(res, rc.off[0]);
+  PyObject* m = get_slot(res, rc.off[1]);
+  if (c == nullptr || m == nullptr) {
+    PyErr_SetString(PyExc_AttributeError, "Resource slot unset");
+    return -1;
+  }
+  *cpu = PyFloat_AsDouble(c);
+  if (*cpu == -1.0 && PyErr_Occurred()) return -1;
+  *mem = PyFloat_AsDouble(m);
+  if (*mem == -1.0 && PyErr_Occurred()) return -1;
+  return 0;
+}
+
+/* ---- collect_pending ------------------------------------------------------ */
+
+struct SortKey {
+  long prio;
+  double ts;
+  PyObject* uid;  // borrowed
+  PyObject* task; // borrowed
+  char plain;
+};
+
+/* collect_pending(jobs, PENDING, eps_cpu, eps_mem, eps_scalar)
+ *
+ * The encoder's per-job pending extraction (ops/encode.py
+ * encode_session): for each JobInfo, take task_status_index[PENDING]
+ * in insertion order, drop tasks whose resreq is empty (every
+ * dimension under its epsilon — resource_info.py is_empty), sort the
+ * rest by (priority desc, pod creation_timestamp, uid) — the serial
+ * pop order (session_plugins.go:329-341) — and classify each task as
+ * "plain": no node selector, no affinity, no tolerations, no volumes,
+ * a single port-less container. Plain tasks skip every per-task
+ * signature/ports/label-key pass on the Python side.
+ *
+ * Returns list[(sorted_task_list, plain_flags_bytes)] parallel to
+ * `jobs`. */
+/* Interned attribute names, resolved once at module init (same pattern
+ * as g_volumes_name). */
+PyObject* g_idx_name = nullptr;
+PyObject* g_meta_name = nullptr;
+PyObject* g_ts_name = nullptr;
+PyObject* g_sel_name = nullptr;
+PyObject* g_aff_name = nullptr;
+PyObject* g_tol_name = nullptr;
+PyObject* g_cont_name = nullptr;
+PyObject* g_ports_name = nullptr;
+
+PyObject* collect_pending(PyObject*, PyObject* args) {
+  PyObject *jobs, *pending_key;
+  double eps_cpu, eps_mem, eps_sc;
+  if (!PyArg_ParseTuple(args, "O!Oddd", &PyList_Type, &jobs, &pending_key,
+                        &eps_cpu, &eps_mem, &eps_sc))
+    return nullptr;
+
+  PyObject* idx_name = g_idx_name;
+  PyObject* meta_name = g_meta_name;
+  PyObject* ts_name = g_ts_name;
+  PyObject* sel_name = g_sel_name;
+  PyObject* aff_name = g_aff_name;
+  PyObject* tol_name = g_tol_name;
+  PyObject* cont_name = g_cont_name;
+  PyObject* ports_name = g_ports_name;
+
+  Py_ssize_t n_jobs = PyList_GET_SIZE(jobs);
+  PyObject* out = PyList_New(n_jobs);
+  if (out == nullptr) return nullptr;
+  std::vector<SortKey> keys;
+
+  for (Py_ssize_t ji = 0; ji < n_jobs; ji++) {
+    PyObject* job = PyList_GET_ITEM(jobs, ji);
+    PyObject* sidx = PyObject_GetAttr(job, idx_name);
+    if (sidx == nullptr || !PyDict_Check(sidx)) {
+      Py_XDECREF(sidx);
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_TypeError, "task_status_index is not a dict");
+      goto fail;
+    }
+    PyObject* pend = PyDict_GetItemWithError(sidx, pending_key);  // borrowed
+    Py_DECREF(sidx);
+    if (pend == nullptr && PyErr_Occurred()) goto fail;
+    keys.clear();
+    if (pend != nullptr) {
+      if (!PyDict_Check(pend)) {
+        PyErr_SetString(PyExc_TypeError, "status bucket is not a dict");
+        goto fail;
+      }
+      Py_ssize_t pos = 0;
+      PyObject *k, *task;
+      while (PyDict_Next(pend, &pos, &k, &task)) {
+        PyTypeObject* tp = Py_TYPE(task);
+        if (g_task_slots.type != tp && resolve_slots(tp, &g_task_slots) < 0)
+          goto fail;
+        const SlotCache& sc = g_task_slots;
+        PyObject* rr = get_slot(task, sc.off[kSlotResreq]);
+        if (rr == nullptr) {
+          PyErr_SetString(PyExc_AttributeError, "resreq slot unset");
+          goto fail;
+        }
+        PyTypeObject* rtp = Py_TYPE(rr);
+        if (g_res_slots.type != rtp &&
+            resolve_res_slots(rtp, &g_res_slots) < 0)
+          goto fail;
+        const ResSlotCache& rc = g_res_slots;
+        double cpu, mem;
+        if (res_cpu_mem(rr, rc, &cpu, &mem) < 0) goto fail;
+        // is_empty parity (resource_info.py): below-epsilon everywhere
+        bool empty = cpu < eps_cpu && mem < eps_mem;
+        PyObject* scal = get_slot(rr, rc.off[2]);
+        if (empty && scal != nullptr && PyDict_Check(scal) &&
+            PyDict_GET_SIZE(scal) > 0) {
+          Py_ssize_t spos = 0;
+          PyObject *sk, *sv;
+          while (PyDict_Next(scal, &spos, &sk, &sv)) {
+            double q = PyFloat_AsDouble(sv);
+            if (q == -1.0 && PyErr_Occurred()) goto fail;
+            if (!(q < eps_sc)) {
+              empty = false;
+              break;
+            }
+          }
+        }
+        if (empty) continue;  // backfill's business, not allocate's
+
+        SortKey key;
+        key.task = task;
+        PyObject* pr = get_slot(task, sc.off[kPriority]);
+        key.prio = pr ? PyLong_AsLong(pr) : 0;
+        if (key.prio == -1 && PyErr_Occurred()) goto fail;
+        key.uid = get_slot(task, sc.off[kUid]);
+        if (key.uid == nullptr || !PyUnicode_Check(key.uid)) {
+          PyErr_SetString(PyExc_TypeError, "task.uid is not a str");
+          goto fail;
+        }
+        PyObject* pod = get_slot(task, sc.off[kPod]);
+        PyObject* meta = pod ? PyObject_GetAttr(pod, meta_name) : nullptr;
+        PyObject* ts = meta ? PyObject_GetAttr(meta, ts_name) : nullptr;
+        Py_XDECREF(meta);
+        if (ts == nullptr) goto fail;
+        key.ts = PyFloat_AsDouble(ts);
+        Py_DECREF(ts);
+        if (key.ts == -1.0 && PyErr_Occurred()) goto fail;
+
+        // plain-ness: selector / affinity / tolerations / volumes /
+        // single port-less container (mirrors _task_signature's and
+        // _task_ports' fast paths)
+        key.plain = 0;
+        PyObject* v = PyObject_GetAttr(pod, sel_name);
+        if (v == nullptr) goto fail;
+        int truthy = PyObject_IsTrue(v);
+        Py_DECREF(v);
+        if (truthy < 0) goto fail;
+        if (!truthy) {
+          v = PyObject_GetAttr(pod, aff_name);
+          if (v == nullptr) goto fail;
+          bool aff_none = (v == Py_None);
+          Py_DECREF(v);
+          if (aff_none) {
+            v = PyObject_GetAttr(pod, tol_name);
+            if (v == nullptr) goto fail;
+            truthy = PyObject_IsTrue(v);
+            Py_DECREF(v);
+            if (truthy < 0) goto fail;
+            if (!truthy) {
+              v = PyObject_GetAttr(pod, g_volumes_name);
+              if (v == nullptr) goto fail;
+              truthy = PyObject_IsTrue(v);
+              Py_DECREF(v);
+              if (truthy < 0) goto fail;
+              if (!truthy) {
+                PyObject* conts = PyObject_GetAttr(pod, cont_name);
+                if (conts == nullptr) goto fail;
+                if (PyList_Check(conts) && PyList_GET_SIZE(conts) == 1) {
+                  PyObject* ports =
+                      PyObject_GetAttr(PyList_GET_ITEM(conts, 0), ports_name);
+                  if (ports == nullptr) {
+                    Py_DECREF(conts);
+                    goto fail;
+                  }
+                  truthy = PyObject_IsTrue(ports);
+                  Py_DECREF(ports);
+                  if (truthy < 0) {
+                    Py_DECREF(conts);
+                    goto fail;
+                  }
+                  key.plain = truthy ? 0 : 1;
+                }
+                Py_DECREF(conts);
+              }
+            }
+          }
+        }
+        keys.push_back(key);
+      }
+    }
+    // (priority desc, creation_timestamp, uid) — stable, uid last
+    std::stable_sort(keys.begin(), keys.end(),
+                     [](const SortKey& a, const SortKey& b) {
+                       if (a.prio != b.prio) return a.prio > b.prio;
+                       if (a.ts != b.ts) return a.ts < b.ts;
+                       return PyUnicode_Compare(a.uid, b.uid) < 0;
+                     });
+    {
+      Py_ssize_t m = (Py_ssize_t)keys.size();
+      PyObject* tl = PyList_New(m);
+      PyObject* flags = PyBytes_FromStringAndSize(nullptr, m);
+      if (tl == nullptr || flags == nullptr) {
+        Py_XDECREF(tl);
+        Py_XDECREF(flags);
+        goto fail;
+      }
+      char* fb = PyBytes_AS_STRING(flags);
+      for (Py_ssize_t i = 0; i < m; i++) {
+        Py_INCREF(keys[i].task);
+        PyList_SET_ITEM(tl, i, keys[i].task);
+        fb[i] = keys[i].plain;
+      }
+      PyObject* pair = PyTuple_Pack(2, tl, flags);
+      Py_DECREF(tl);
+      Py_DECREF(flags);
+      if (pair == nullptr) goto fail;
+      PyList_SET_ITEM(out, ji, pair);
+    }
+  }
+  return out;
+fail:
+  Py_DECREF(out);
+  return nullptr;
+}
+
+/* ---- encode-side extractors ---------------------------------------------- */
+
 struct F32F64Buf {
   Py_buffer view{};
   bool is_f64 = false;
@@ -332,22 +570,6 @@ inline void put_f(const F32F64Buf& b, Py_ssize_t flat_ix, double v) {
     ((double*)b.view.buf)[flat_ix] = v;
   else
     ((float*)b.view.buf)[flat_ix] = (float)v;
-}
-
-/* Read resource.milli_cpu / resource.memory as doubles; -1 on error. */
-inline int res_cpu_mem(PyObject* res, const ResSlotCache& rc, double* cpu,
-                       double* mem) {
-  PyObject* c = get_slot(res, rc.off[0]);
-  PyObject* m = get_slot(res, rc.off[1]);
-  if (c == nullptr || m == nullptr) {
-    PyErr_SetString(PyExc_AttributeError, "Resource slot unset");
-    return -1;
-  }
-  *cpu = PyFloat_AsDouble(c);
-  if (*cpu == -1.0 && PyErr_Occurred()) return -1;
-  *mem = PyFloat_AsDouble(m);
-  if (*mem == -1.0 && PyErr_Occurred()) return -1;
-  return 0;
 }
 
 /* extract_task_columns(tasks, job_idx, req, res, job_out, has_sc,
@@ -555,6 +777,9 @@ PyMethodDef methods[] = {
      "Apply kernel assignment events to session TaskInfo/node state."},
     {"bulk_set_slot", bulk_set_slot, METH_VARARGS,
      "Set one __slots__ attribute on every object in a list."},
+    {"collect_pending", collect_pending, METH_VARARGS,
+     "Per-job pending extraction: filter empties, pop-order sort, "
+     "plain-task classification."},
     {"extract_task_columns", extract_task_columns, METH_VARARGS,
      "Fill SoA request/limit/job/scalar-flag columns from TaskInfos."},
     {"extract_node_columns", extract_node_columns, METH_VARARGS,
@@ -572,6 +797,17 @@ PyModuleDef moduledef = {
 
 PyMODINIT_FUNC PyInit__hotloops(void) {
   g_volumes_name = PyUnicode_InternFromString("volumes");
-  if (g_volumes_name == nullptr) return nullptr;
+  g_idx_name = PyUnicode_InternFromString("task_status_index");
+  g_meta_name = PyUnicode_InternFromString("metadata");
+  g_ts_name = PyUnicode_InternFromString("creation_timestamp");
+  g_sel_name = PyUnicode_InternFromString("node_selector");
+  g_aff_name = PyUnicode_InternFromString("affinity");
+  g_tol_name = PyUnicode_InternFromString("tolerations");
+  g_cont_name = PyUnicode_InternFromString("containers");
+  g_ports_name = PyUnicode_InternFromString("ports");
+  if (!g_volumes_name || !g_idx_name || !g_meta_name || !g_ts_name ||
+      !g_sel_name || !g_aff_name || !g_tol_name || !g_cont_name ||
+      !g_ports_name)
+    return nullptr;
   return PyModule_Create(&moduledef);
 }

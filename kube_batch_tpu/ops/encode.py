@@ -64,7 +64,6 @@ from kube_batch_tpu.api.queue_info import QueueInfo
 from kube_batch_tpu.api.resource_info import Resource
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.apis.types import PodGroupPhase
-from kube_batch_tpu.plugins.nodeorder import node_affinity_score
 from kube_batch_tpu.plugins.predicates import (
     check_node_condition,
     check_node_selector,
@@ -110,6 +109,57 @@ def _node_bucket(n: int) -> int:
 
 
 _PLAIN_SIG = ((), "None", (), ())
+
+
+def group_by_signature(items, sig_fn):
+    """Dedup `items` by signature: returns (gids int32[len(items)],
+    reps) with group ids in first-occurrence order — the (task-group ×
+    node-group) machinery shared by the encoder and the vectorized
+    backfill scan."""
+    groups: dict = {}
+    gids = np.zeros(len(items), np.int32)
+    reps: list = []
+    for i, item in enumerate(items):
+        sig = sig_fn(item)
+        gid = groups.get(sig)
+        if gid is None:
+            gid = groups[sig] = len(reps)
+            reps.append(item)
+        gids[i] = gid
+    return gids, reps
+
+
+def build_static_compat(t_reps, n_reps, aff_sc=None):
+    """[GT, GN] static predicate verdicts per (task-group, node-group)
+    pair — `static_pod_node_compat` over the reps; a node group without
+    a Node object rejects everything (predicates.py). When `aff_sc` is
+    given, the preferred-node-affinity score is filled in the same
+    sweep (the encoder's fused form)."""
+    from kube_batch_tpu.plugins.nodeorder import node_affinity_score
+
+    compat = np.zeros((max(len(t_reps), 1), max(len(n_reps), 1)), bool)
+    for gi, trep in enumerate(t_reps):
+        for gj, nrep in enumerate(n_reps):
+            if nrep.node is None:
+                continue
+            compat[gi, gj] = static_pod_node_compat(trep.pod, nrep.node)
+            if aff_sc is not None:
+                aff_sc[gi, gj] = node_affinity_score(trep, nrep)
+    return compat
+
+
+def static_pod_node_compat(pod, node) -> bool:
+    """The task-static × node-static predicate subset — cordon, node
+    selector/required node affinity, taints (predicates.py) — shared by
+    the encoder's (task-group × node-group) compat matrix and the
+    vectorized backfill scan, so a predicate-chain change lands in one
+    place. The node-dynamic checks (condition/pressure/pod count/ports)
+    and the pairwise pod-affinity check stay with their callers."""
+    return (
+        check_node_unschedulable(pod, node)
+        and check_node_selector(pod, node)
+        and check_taints(pod, node)
+    )
 
 
 def _task_signature(task: TaskInfo, with_labels: bool = False) -> tuple:
@@ -260,22 +310,64 @@ def encode_session(
     )
     queue_idx = {q.name: i for i, q in enumerate(queue_list)}
 
-    job_list: list[JobInfo] = []
-    job_pending: dict[str, list[TaskInfo]] = {}
+    shortlist: list[JobInfo] = []
     for job in jobs.values():
         if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
             continue
         if job.queue not in queues:
             continue
-        pending = [
-            t
-            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
-            if not t.resreq.is_empty()
-        ]
-        if not pending:
-            continue
-        job_list.append(job)
-        job_pending[job.uid] = pending
+        shortlist.append(job)
+
+    # Per-job pending extraction + pop-order sort + plain-task
+    # classification: one native pass when available (native
+    # collect_pending — "plain" = no selector/affinity/tolerations/
+    # volumes/ports, so every later per-task pass can skip the row).
+    collected = None
+    if _native is not None:
+        from kube_batch_tpu.api.resource_info import (
+            MIN_MEMORY,
+            MIN_MILLI_CPU,
+            MIN_MILLI_SCALAR,
+        )
+
+        try:
+            collected = _native.collect_pending(
+                shortlist,
+                TaskStatus.PENDING,
+                float(MIN_MILLI_CPU),
+                float(MIN_MEMORY),
+                float(MIN_MILLI_SCALAR),
+            )
+        except Exception:  # noqa: BLE001 -- fall back to the Python pass
+            _log_native_fallback("collect_pending")
+
+    job_list: list[JobInfo] = []
+    job_pending: dict[str, tuple[list[TaskInfo], Optional[bytes]]] = {}
+    if collected is not None:
+        for job, (pending, flags) in zip(shortlist, collected):
+            if pending:
+                job_list.append(job)
+                job_pending[job.uid] = (pending, flags)
+    else:
+        for job in shortlist:
+            pending = [
+                t
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty()
+            ]
+            if pending:
+                # Within-job pop order = priority desc, creation, uid
+                # (priority plugin task_order_fn + session fallback,
+                # session_plugins.go:329-341). The native pass pre-sorts.
+                pending.sort(
+                    key=lambda t: (
+                        -t.priority,
+                        t.pod.metadata.creation_timestamp,
+                        t.uid,
+                    )
+                )
+                job_list.append(job)
+                job_pending[job.uid] = (pending, None)
     # Stable row order = the serial job heap's fallback order (creation,
     # uid). Dynamic ordering (priority/ready/drf share) is decided by the
     # kernel's selection keys, with this row order as the final key.
@@ -283,6 +375,7 @@ def encode_session(
     job_idx = {j.uid: i for i, j in enumerate(job_list)}
 
     task_list: list[TaskInfo] = []
+    task_plain = bytearray()  # parallel row flags (native-classified)
     host_only: list[TaskInfo] = []
     job_ranges: list[tuple[int, int]] = []
     host_only_rows: list[int] = []
@@ -293,14 +386,20 @@ def encode_session(
     # node-group deduplication (ADVICE r2: encode.py finding).
     ref_label_keys: set[str] = set()
     for job in job_list:
-        pending = job_pending[job.uid]
-        # Within-job pop order = priority desc, creation, uid (priority
-        # plugin task_order_fn + session fallback, session_plugins.go:329-341).
-        pending.sort(
-            key=lambda t: (-t.priority, t.pod.metadata.creation_timestamp, t.uid)
-        )
+        pending, flags = job_pending[job.uid]
         start = len(task_list)
-        for t in pending:
+        if flags is not None and flags.count(0) == 0:
+            # whole job plain: no selector/affinity/volume/port rows
+            task_list.extend(pending)
+            task_plain.extend(flags)
+            job_ranges.append((start, len(task_list)))
+            continue
+        for off, t in enumerate(pending):
+            if flags is not None and flags[off]:
+                task_plain.append(1)
+                task_list.append(t)
+                continue
+            task_plain.append(0)
             pod = t.pod
             if pod.node_selector:
                 ref_label_keys.update(pod.node_selector)
@@ -350,7 +449,17 @@ def encode_session(
     Q = _bucket(q_n, 2) if pad else max(q_n, 1)
 
     # -- ports ---------------------------------------------------------------
-    interesting_ports = sorted({p for t in task_list for p in _task_ports(t)})
+    # plain rows have no ports by classification, so only the non-plain
+    # rows can contribute (flag shortcuts apply whenever the native
+    # collect pass classified; otherwise every row is scanned)
+    interesting_ports = sorted(
+        {
+            p
+            for i, t in enumerate(task_list)
+            if not task_plain[i]
+            for p in _task_ports(t)
+        }
+    )
     port_idx = {p: i for i, p in enumerate(interesting_ports)}
     P = max(len(interesting_ports), 1)
 
@@ -359,34 +468,30 @@ def encode_session(
     t_groups: dict[tuple, int] = {}
     task_gid = np.zeros(T, np.int32)
     t_reps: list[TaskInfo] = []
-    for i, t in enumerate(task_list):
-        sig = _task_signature(t, with_labels=interpod_active)
-        if sig not in t_groups:
-            t_groups[sig] = len(t_reps)
-            t_reps.append(t)
-        task_gid[i] = t_groups[sig]
-    n_groups: dict[tuple, int] = {}
+    if interpod_active:
+        # signatures read pod labels: no plain-row shortcut (a plain pod
+        # with labels is a distinct group under InterPodAffinity)
+        for i, t in enumerate(task_list):
+            sig = _task_signature(t, with_labels=True)
+            if sig not in t_groups:
+                t_groups[sig] = len(t_reps)
+                t_reps.append(t)
+            task_gid[i] = t_groups[sig]
+    else:
+        for i, t in enumerate(task_list):
+            sig = _PLAIN_SIG if task_plain[i] else _task_signature(t)
+            if sig not in t_groups:
+                t_groups[sig] = len(t_reps)
+                t_reps.append(t)
+            task_gid[i] = t_groups[sig]
+    node_gids, n_reps = group_by_signature(
+        node_list, lambda n: _node_signature(n, label_keys)
+    )
     node_gid = np.zeros(N, np.int32)
-    n_reps: list[NodeInfo] = []
-    for i, n in enumerate(node_list):
-        sig = _node_signature(n, label_keys)
-        if sig not in n_groups:
-            n_groups[sig] = len(n_reps)
-            n_reps.append(n)
-        node_gid[i] = n_groups[sig]
+    node_gid[: len(node_gids)] = node_gids
     GT, GN = max(len(t_reps), 1), max(len(n_reps), 1)
-    compat = np.zeros((GT, GN), bool)
     aff_sc = np.zeros((GT, GN), dtype)
-    for gi, trep in enumerate(t_reps):
-        for gj, nrep in enumerate(n_reps):
-            if nrep.node is None:
-                continue  # predicates.py: no node object -> reject
-            compat[gi, gj] = (
-                check_node_unschedulable(trep.pod, nrep.node)
-                and check_node_selector(trep.pod, nrep.node)
-                and check_taints(trep.pod, nrep.node)
-            )
-            aff_sc[gi, gj] = node_affinity_score(trep, nrep)
+    compat = build_static_compat(t_reps, n_reps, aff_sc=aff_sc)
 
     # -- task arrays (bulk-filled: one ndarray conversion, not 50k row
     #    assignments — encode_s is on the session critical path) -----------
@@ -444,6 +549,8 @@ def encode_session(
     if t_n:
         if interesting_ports:
             for i, t in enumerate(task_list):
+                if task_plain[i]:
+                    continue
                 for p in _task_ports(t):
                     task_ports[i, port_idx[p]] = True
     task_host_only[host_only_rows] = True

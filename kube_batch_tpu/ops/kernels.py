@@ -114,6 +114,32 @@ def _lex_argmin(mask, *keys):
     return jnp.argmax(m), jnp.any(mask)
 
 
+def ieee_div(x, y):
+    """Correctly-rounded x/y on backends whose divide is reciprocal-based
+    (measured 1-2 ulp off IEEE on both the XLA CPU and TPU builds here —
+    enough to flip share ties and floor((cap-req)*10/cap) boundaries vs
+    the serial Python oracle, which divides correctly rounded). One
+    Newton correction with a Dekker/Veltkamp two-product residual: only
+    IEEE-exact mul/add/sub plus the sloppy divide on an ulp-scale
+    numerator, so the correction cannot perturb an already-correct
+    quotient."""
+    q = x / y
+    split = jnp.asarray(
+        134217729.0 if jnp.asarray(q).dtype == jnp.float64 else 4097.0,
+        jnp.asarray(q).dtype,
+    )  # 2^27+1 / 2^12+1: Veltkamp split constants
+    c = split * q
+    qh = c - (c - q)
+    ql = q - qh
+    d = split * y
+    yh = d - (d - y)
+    yl = y - yh
+    p = q * y
+    e = ((qh * yh - p) + qh * yl + ql * yh) + ql * yl  # q*y - p, exactly
+    r = (x - p) - e  # residual x - q*y (x-p exact by Sterbenz: p ~ x)
+    return q + r / y
+
+
 def _le_eps(req, pool, eps):
     """Vectorized Resource.less_equal over the node axis
     (resource_info.go:255-278): per-dimension l < r + eps."""
@@ -125,7 +151,7 @@ def _share_rows(alloc, denom, dims):
     share(alloc, denom) with 0/0 -> 0, x/0 -> 1 (helpers.go:43-60,
     drf.go:161-171, proportion.go:211-223)."""
     safe = jnp.where(denom == 0, 1.0, denom)
-    s = jnp.where(denom == 0, jnp.where(alloc == 0, 0.0, 1.0), alloc / safe)
+    s = jnp.where(denom == 0, jnp.where(alloc == 0, 0.0, 1.0), ieee_div(alloc, safe))
     s = jnp.where(dims, s, -jnp.inf)
     return jnp.maximum(jnp.max(s, axis=-1), 0.0)
 
@@ -313,12 +339,16 @@ def solve_allocate_step(
 
         def least_dim(rq, cp):
             safe = jnp.where(cp == 0, 1.0, cp)
-            sc = jnp.floor((cp - rq) * MAX_PRIORITY / safe).astype(jnp.int32)
+            sc = jnp.floor(ieee_div((cp - rq) * MAX_PRIORITY, safe)).astype(jnp.int32)
             return jnp.where((cp == 0) | (rq > cp), 0, sc)
 
         least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
-        cpu_f = jnp.where(cap_cpu != 0, req_cpu / jnp.where(cap_cpu == 0, 1.0, cap_cpu), 1.0)
-        mem_f = jnp.where(cap_mem != 0, req_mem / jnp.where(cap_mem == 0, 1.0, cap_mem), 1.0)
+        cpu_f = jnp.where(
+            cap_cpu != 0, ieee_div(req_cpu, jnp.where(cap_cpu == 0, 1.0, cap_cpu)), 1.0
+        )
+        mem_f = jnp.where(
+            cap_mem != 0, ieee_div(req_mem, jnp.where(cap_mem == 0, 1.0, cap_mem)), 1.0
+        )
         balanced = jnp.where(
             (cpu_f >= 1.0) | (mem_f >= 1.0),
             0,

@@ -51,7 +51,7 @@ from functools import lru_cache
 
 import numpy as np
 
-from kube_batch_tpu.ops.kernels import SolveState
+from kube_batch_tpu.ops.kernels import SolveState, ieee_div as _ieee_div
 
 R8 = 8  # padded resource rank (milli-cpu, memory, <=6 scalar resources)
 LANES = 128
@@ -481,7 +481,7 @@ def _build(
                 sr = jnp.where(
                     denom == 0.0,
                     jnp.where(alloc_r == 0.0, 0.0, 1.0),
-                    alloc_r / jnp.where(denom == 0.0, 1.0, denom),
+                    _ieee_div(alloc_r, jnp.where(denom == 0.0, 1.0, denom)),
                 )
                 s = jnp.where(drfd_ref[r] != 0, jnp.maximum(s, sr), s)
             return jnp.maximum(s, 0.0)
@@ -494,7 +494,7 @@ def _build(
                 sr = jnp.where(
                     d == 0.0,
                     jnp.where(al == 0.0, 0.0, 1.0),
-                    al / jnp.where(d == 0.0, 1.0, d),
+                    _ieee_div(al, jnp.where(d == 0.0, 1.0, d)),
                 )
                 s = jnp.where(qdim_ref[r, :, :] != 0.0, jnp.maximum(s, sr), s)
             return jnp.maximum(s, 0.0)
@@ -604,15 +604,21 @@ def _build(
 
             def least_dim(rq, cp):
                 safe = jnp.where(cp == 0.0, 1.0, cp)
-                sc = jnp.floor((cp - rq) * MAX_PRIORITY / safe).astype(jnp.int32)
+                sc = jnp.floor(
+                    _ieee_div((cp - rq) * MAX_PRIORITY, safe)
+                ).astype(jnp.int32)
                 return jnp.where((cp == 0.0) | (rq > cp), 0, sc)
 
             least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
             cpu_f = jnp.where(
-                cap_cpu != 0.0, req_cpu / jnp.where(cap_cpu == 0.0, 1.0, cap_cpu), 1.0
+                cap_cpu != 0.0,
+                _ieee_div(req_cpu, jnp.where(cap_cpu == 0.0, 1.0, cap_cpu)),
+                1.0,
             )
             mem_f = jnp.where(
-                cap_mem != 0.0, req_mem / jnp.where(cap_mem == 0.0, 1.0, cap_mem), 1.0
+                cap_mem != 0.0,
+                _ieee_div(req_mem, jnp.where(cap_mem == 0.0, 1.0, cap_mem)),
+                1.0,
             )
             balanced = jnp.where(
                 (cpu_f >= 1.0) | (mem_f >= 1.0),

@@ -159,6 +159,114 @@ class TestBulkAssign:
             )
 
 
+class TestCollectPending:
+    def _python_twin(self, jobs):
+        from kube_batch_tpu.api.types import TaskStatus
+
+        out = []
+        for job in jobs:
+            pending = [
+                t
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty()
+            ]
+            pending.sort(
+                key=lambda t: (-t.priority, t.pod.metadata.creation_timestamp, t.uid)
+            )
+            out.append(pending)
+        return out
+
+    def _jobs(self):
+        import random
+
+        from kube_batch_tpu.api.job_info import JobInfo
+        from kube_batch_tpu.api.types import TaskStatus
+
+        rng = random.Random(11)
+        jobs = []
+        for j in range(6):
+            job = JobInfo(uid=f"job-{j}")
+            for i in range(rng.randint(0, 12)):
+                t = build_task(
+                    namespace="ns",
+                    name=f"j{j}t{i}",
+                    req=rng.choice([{"cpu": 1.0}, {"cpu": 0.5}, None]),
+                    priority=rng.choice([None, 1, 5, 9]),
+                )
+                t.pod.metadata.creation_timestamp = rng.choice([100.0, 200.0, 300.0])
+                if rng.random() < 0.3:
+                    t.pod.node_selector["zone"] = "a"
+                if rng.random() < 0.2:
+                    t.pod.containers[0].ports = [8080]
+                job.add_task_info(t)
+            jobs.append(job)
+        return jobs
+
+    def test_matches_python_extraction(self):
+        from kube_batch_tpu.api.resource_info import (
+            MIN_MEMORY,
+            MIN_MILLI_CPU,
+            MIN_MILLI_SCALAR,
+        )
+        from kube_batch_tpu.api.types import TaskStatus
+
+        jobs = self._jobs()
+        native = lib.collect_pending(
+            jobs, TaskStatus.PENDING, MIN_MILLI_CPU, MIN_MEMORY, MIN_MILLI_SCALAR
+        )
+        python = self._python_twin(jobs)
+        assert len(native) == len(python)
+        for (n_tasks, flags), p_tasks in zip(native, python):
+            assert [t.uid for t in n_tasks] == [t.uid for t in p_tasks]
+            for t, fl in zip(n_tasks, flags):
+                plain = (
+                    not t.pod.node_selector
+                    and t.pod.affinity is None
+                    and not t.pod.tolerations
+                    and not t.pod.volumes
+                    and len(t.pod.containers) == 1
+                    and not t.pod.containers[0].ports
+                )
+                assert bool(fl) == plain, t.uid
+
+    def test_empty_resreq_excluded(self):
+        from kube_batch_tpu.api.job_info import JobInfo
+        from kube_batch_tpu.api.resource_info import (
+            MIN_MEMORY,
+            MIN_MILLI_CPU,
+            MIN_MILLI_SCALAR,
+        )
+        from kube_batch_tpu.api.types import TaskStatus
+
+        job = JobInfo(uid="j")
+        job.add_task_info(build_task(namespace="ns", name="be", req=None))
+        job.add_task_info(build_task(namespace="ns", name="real", req={"cpu": 1.0}))
+        (tasks, flags), = lib.collect_pending(
+            [job], TaskStatus.PENDING, MIN_MILLI_CPU, MIN_MEMORY, MIN_MILLI_SCALAR
+        )
+        assert [t.name for t in tasks] == ["real"]
+
+    def test_encode_with_and_without_native_agree(self, monkeypatch):
+        import numpy as np
+
+        import kube_batch_tpu.ops.encode as E
+        from kube_batch_tpu.models import multi_tenant_ml
+        from kube_batch_tpu.testing import FakeCache
+
+        def enc():
+            cluster = FakeCache(multi_tenant_ml()).snapshot()
+            return E.encode_session(cluster.jobs, cluster.nodes, cluster.queues)
+
+        a = enc()
+        monkeypatch.setattr(E, "_native", None)
+        b = enc()
+        assert [t.uid for t in a.tasks] == [t.uid for t in b.tasks]
+        for k in a.arrays:
+            np.testing.assert_array_equal(
+                np.asarray(a.arrays[k]), np.asarray(b.arrays[k]), err_msg=k
+            )
+
+
 class TestBulkSetSlot:
     def test_sets_every_object(self):
         tasks = _mk_tasks(50)
