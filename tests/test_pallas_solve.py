@@ -177,6 +177,27 @@ def test_supported_envelope_edges():
     assert not pallas_solve.supported(base(GT=4096, N=8192))
 
 
+def test_vmem_budget_is_device_aware(monkeypatch):
+    """v5e-class cores (128 MiB VMEM) get the wide budget — measured on
+    the bench chip: 400k x 40k (~33 MiB resident) compiles and runs —
+    while unknown cores keep the conservative default, and
+    KBT_VMEM_BUDGET overrides both."""
+    import jax
+
+    from kube_batch_tpu.ops import pallas_solve
+
+    class Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [Dev("TPU v5 lite")])
+    assert pallas_solve.vmem_budget() == 96 * 1024 * 1024
+    monkeypatch.setattr(jax, "devices", lambda *a: [Dev("TPU v3")])
+    assert pallas_solve.vmem_budget() == pallas_solve._DEFAULT_VMEM_BUDGET
+    monkeypatch.setenv("KBT_VMEM_BUDGET", str(7 * 1024 * 1024))
+    assert pallas_solve.vmem_budget() == 7 * 1024 * 1024
+
+
 def test_many_scalar_resources_falls_back_to_lax(monkeypatch):
     """A cluster with 7+ distinct scalar resources (R > 8) runs the XLA
     kernel via the action and still matches serial."""

@@ -531,3 +531,39 @@ def test_cli_queue_list_watch(server):
     assert done.wait(timeout=15), "CLI watch never returned"
     text = out.getvalue()
     assert "ADDED" in text and "streamed" in text, text
+
+
+def test_add_flags_snapshot():
+    """options_test.go:27 TestAddFlags — overriding one flag leaves every
+    other option at its documented default."""
+    from kube_batch_tpu.server import (
+        DEFAULT_LISTEN_ADDRESS,
+        DEFAULT_QUEUE,
+        DEFAULT_SCHEDULER_NAME,
+        build_parser,
+    )
+
+    opt = build_parser().parse_args(["--schedule-period", "300"])
+    assert opt.schedule_period == 300.0
+    assert opt.scheduler_name == DEFAULT_SCHEDULER_NAME
+    assert opt.default_queue == DEFAULT_QUEUE
+    assert opt.listen_address == DEFAULT_LISTEN_ADDRESS
+    assert opt.scheduler_conf == "" and not opt.leader_elect and opt.v == 0
+
+
+def test_select_best_node():
+    """scheduler_helper_test.go:26 TestSelectBestNode — the highest score
+    bucket wins (our pick inside the bucket is deterministic first-entry;
+    the reference randomizes, so any bucket member is a valid answer)."""
+    from kube_batch_tpu.api.node_info import NodeInfo
+    from kube_batch_tpu.utils import select_best_node
+
+    def node(name):
+        n = NodeInfo()
+        n.name = name
+        return n
+
+    n1, n2, n3, n4, n5 = (node(f"node{i}") for i in range(1, 6))
+    assert select_best_node({1.0: [n1, n2], 2.0: [n3, n4]}) in (n3, n4)
+    assert select_best_node({1.0: [n1, n2], 3.0: [n3], 2.0: [n4, n5]}) is n3
+    assert select_best_node({}) is None
