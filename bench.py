@@ -137,6 +137,9 @@ def timed(make_cluster, action_name: str, warm: bool, repeats: int = 2):
 
 
 def main() -> None:
+    from kube_batch_tpu.ops import enable_compilation_cache
+
+    enable_compilation_cache()
     details = {}
     full_serial = os.environ.get("KBT_BENCH_FULL_SERIAL") == "1"
 

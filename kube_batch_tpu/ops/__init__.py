@@ -19,7 +19,12 @@ def enable_compilation_cache() -> None:
     padding bucket changes shape, but a fresh process (server restart,
     bench run, failover standby taking over) pays each bucket's 10-30 s
     trace+compile again without one. Opt-out with KBT_JAX_CACHE=0 or
-    point KBT_JAX_CACHE at a directory."""
+    point KBT_JAX_CACHE at a directory.
+
+    Called by the scheduler entry points (Scheduler init, bench, the
+    graft entry) — deliberately NOT at import, so an embedding
+    application that configures jax itself keeps full control no matter
+    the import order; it defers to any cache dir already set."""
     spec = _os.environ.get("KBT_JAX_CACHE", "")
     if spec == "0":
         return
@@ -49,8 +54,6 @@ def enable_compilation_cache() -> None:
             "persistent jax compilation cache unavailable", exc_info=True
         )
 
-
-enable_compilation_cache()
 
 from kube_batch_tpu.ops.encode import EncodedSnapshot, encode_session  # noqa: E402
 from kube_batch_tpu.ops.kernels import solve_allocate  # noqa: E402

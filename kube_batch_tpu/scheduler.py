@@ -56,6 +56,12 @@ class Scheduler:
         scheduler_conf: Optional[str] = None,
         schedule_period: float = 1.0,
     ) -> None:
+        # A scheduler process wants the persistent XLA compile cache
+        # (restart/failover skips the bucket compiles); the call is lazy
+        # so embedders who configure jax themselves are never overridden.
+        from kube_batch_tpu.ops import enable_compilation_cache
+
+        enable_compilation_cache()
         self.cache = cache
         self.scheduler_conf = scheduler_conf  # path; None -> default conf
         self.schedule_period = schedule_period

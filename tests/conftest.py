@@ -24,6 +24,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compile cache stays inside the repo (gitignored), not the
+# developer's $HOME: warm across local runs, easy to wipe, no pollution.
+os.environ.setdefault(
+    "KBT_JAX_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".pytest_cache", "jax"),
+)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

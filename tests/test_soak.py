@@ -1,10 +1,14 @@
-"""Committed soak/churn test (VERDICT r3 item 6, reference
+"""Committed soak/churn tests (VERDICT r3 item 6, reference
 test/kubemark methodology at CI-tolerable scale): thousands of pods
 churned through the live server loop over hundreds of cycles, asserting
-no job/task leaks in the cache or store and bounded process RSS."""
+no job/task leaks in the cache or store and bounded process RSS — once
+through the default serial pipeline, once through the full TPU conf
+(xla actions + tensorscore), sharing one churn driver so the two stay
+assertion-identical."""
 
 from __future__ import annotations
 
+import pathlib
 import resource
 import time
 
@@ -17,6 +21,8 @@ from kube_batch_tpu.testing import (
     build_pod_group,
     build_resource_list,
 )
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
 
 
 def wait_until(pred, timeout=30.0, what="condition"):
@@ -32,31 +38,43 @@ def rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
-@pytest.mark.slow
-def test_soak_churn_no_leaks():
-    """5k pods over 100 generations (hundreds of scheduler cycles at a
-    20ms period): every generation creates gangs, waits for binds,
-    deletes the pods and groups, and the cache must drain completely —
-    jobs GC'd through deletedJobs, no task residue on nodes, store
-    empty — with peak RSS growth bounded."""
-    srv = SchedulerServer(listen_address="127.0.0.1:0", schedule_period=0.02)
+def churn(
+    scheduler_conf,
+    generations,
+    schedule,
+    n_nodes,
+    warmup_gen,
+    rss_budget_mb,
+    bind_timeout,
+    prefix,
+):
+    """One soak run: per generation create the scheduled gangs, wait for
+    every pod to bind, tear everything down; afterwards the store and
+    cache must drain completely and peak RSS growth past `warmup_gen`
+    stays under budget. `schedule(gen) -> (gangs, gang_size)`."""
+    srv = SchedulerServer(
+        listen_address="127.0.0.1:0",
+        schedule_period=0.02,
+        scheduler_conf=scheduler_conf,
+    )
     srv.start()
     store = srv.store
     cache = srv.cache
-    n_nodes, gangs_per_gen, gang_size, generations = 20, 5, 10, 100
     try:
         for i in range(n_nodes):
             store.create_node(
-                build_node(f"n{i:02d}", build_resource_list(cpu=16, memory="32Gi", pods=110))
+                build_node(
+                    f"n{i:02d}", build_resource_list(cpu=16, memory="32Gi", pods=110)
+                )
             )
-
         warmup_rss = None
         for gen in range(generations):
+            gangs, size = schedule(gen)
             names = []
-            for g in range(gangs_per_gen):
-                pg_name = f"gen{gen}-g{g}"
-                store.create_pod_group(build_pod_group(pg_name, min_member=gang_size))
-                for t in range(gang_size):
+            for g in range(gangs):
+                pg_name = f"{prefix}{gen}-g{g}"
+                store.create_pod_group(build_pod_group(pg_name, min_member=size))
+                for t in range(size):
                     store.create_pod(
                         build_pod(
                             name=f"{pg_name}-t{t}",
@@ -65,26 +83,25 @@ def test_soak_churn_no_leaks():
                         )
                     )
                 names.append(pg_name)
-
-            expected = gangs_per_gen * gang_size
+            expected = gangs * size
             wait_until(
                 lambda: sum(
-                    1 for p in store.list("pods") if p.node_name and p.metadata.name.startswith(f"gen{gen}-")
+                    1
+                    for p in store.list("pods")
+                    if p.node_name and p.metadata.name.startswith(f"{prefix}{gen}-")
                 )
                 == expected,
+                timeout=bind_timeout(gen),
                 what=f"generation {gen} fully bound",
             )
-
-            # completion + teardown: delete pods and their groups
             for pg_name in names:
-                for t in range(gang_size):
+                for t in range(size):
                     store.delete_pod("default", f"{pg_name}-t{t}")
                 store.delete_pod_group("default", pg_name)
-
-            if gen == 4:
+            if gen == warmup_gen:
                 warmup_rss = rss_mb()
 
-        # -- leak assertions -------------------------------------------
+        # -- leak assertions (identical for every pipeline) ---------------
         assert store.list("pods") == []
         assert store.list("podgroups") == []
         wait_until(
@@ -96,8 +113,48 @@ def test_soak_churn_no_leaks():
             assert node.used.milli_cpu == 0, f"used residue on {node.name}"
         # errTasks should hold nothing once everything bound cleanly
         assert len(cache._err_tasks) == 0
-
         growth = rss_mb() - warmup_rss
-        assert growth < 200, f"peak RSS grew {growth:.0f}MB over the churn"
+        assert growth < rss_budget_mb, (
+            f"peak RSS grew {growth:.0f}MB over the churn"
+        )
     finally:
         srv.stop()
+
+
+@pytest.mark.slow
+def test_soak_churn_no_leaks():
+    """5k pods over 100 generations (hundreds of scheduler cycles at a
+    20ms period) through the default serial pipeline."""
+    churn(
+        scheduler_conf=None,
+        generations=100,
+        schedule=lambda gen: (5, 10),
+        n_nodes=20,
+        warmup_gen=4,
+        rss_budget_mb=200,
+        bind_timeout=lambda gen: 30,
+        prefix="gen",
+    )
+
+
+@pytest.mark.slow
+def test_soak_churn_tpu_pipeline():
+    """The same churn through the full TPU conf (xla_reclaim,
+    xla_allocate, xla_backfill, xla_preempt + tensorscore): every
+    generation's gangs bind via encode → device solve → bulk replay —
+    catching leaks in the encoder caches, solver state, or the native
+    bulk-replay surgery, plus compile-cache stability across padding
+    buckets. The (gangs, size) schedule has period 6, so generations
+    0-5 each introduce a fresh (task, job) bucket combo and get the
+    full jit-compile timeout; RSS warmup is sampled only after every
+    bucket shape has been seen."""
+    churn(
+        scheduler_conf=str(EXAMPLES / "scheduler-conf-tpu.yaml"),
+        generations=30,
+        schedule=lambda gen: (3 + (gen % 3) * 2, 6 + (gen % 2) * 6),
+        n_nodes=16,
+        warmup_gen=5,
+        rss_budget_mb=300,
+        bind_timeout=lambda gen: 180 if gen < 6 else 30,
+        prefix="tgen",
+    )
