@@ -344,25 +344,36 @@ tiers:
 def gen_cluster(seed: int):
     """Random cluster on the milli/MiB grid: gang jobs, priorities,
     selectors, taints/tolerations, preloaded running + releasing pods,
-    multiple queues."""
+    multiple queues, and (on some seeds) scalar accelerator resources —
+    the dims that drive the drf/proportion Go nil-scalar-map parity
+    bits and the scalar feasibility gates (resource_info.go:255-278)."""
+    from kube_batch_tpu.models import GPU
+
     rng = random.Random(seed)
     n_queues = rng.randint(1, 3)
     queues = [build_queue(f"q{i}", weight=rng.randint(1, 3)) for i in range(n_queues)]
     for i, q in enumerate(queues):
         q.metadata.creation_timestamp = float(i)
 
+    # a third of the clusters carry an accelerator scalar on part of
+    # the fleet, with some pods requesting it
+    with_scalars = rng.random() < 0.35
+
     nodes = []
     for i in range(rng.randint(3, 10)):
         labels = {}
         if rng.random() < 0.4:
             labels["zone"] = rng.choice(["a", "b"])
+        rl = build_resource_list(
+            cpu=rng.randint(1, 8),
+            memory=f"{rng.choice([1024, 2048, 4096, 8192])}Mi",
+            pods=rng.randint(3, 12),
+        )
+        if with_scalars and rng.random() < 0.6:
+            rl[GPU] = float(rng.choice([1, 2, 4]))
         node = build_node(
             f"n{i:02d}",
-            build_resource_list(
-                cpu=rng.randint(1, 8),
-                memory=f"{rng.choice([1024, 2048, 4096, 8192])}Mi",
-                pods=rng.randint(3, 12),
-            ),
+            rl,
             labels=labels,
         )
         if rng.random() < 0.15:
@@ -382,13 +393,16 @@ def gen_cluster(seed: int):
         pgs.append(pg)
         prio = rng.choice([None, 1, 5, 9])
         for t in range(n_tasks):
+            req = build_resource_list(
+                cpu=f"{rng.randint(1, 16) * 250}m",
+                memory=f"{rng.choice([128, 256, 512, 1024, 2048])}Mi",
+            )
+            if with_scalars and rng.random() < 0.4:
+                req[GPU] = float(rng.choice([1, 2]))
             pod = build_pod(
                 name=f"{name}-t{t}",
                 group_name=name,
-                req=build_resource_list(
-                    cpu=f"{rng.randint(1, 16) * 250}m",
-                    memory=f"{rng.choice([128, 256, 512, 1024, 2048])}Mi",
-                ),
+                req=req,
                 priority=prio if rng.random() < 0.8 else rng.choice([1, 5, 9]),
             )
             pod.metadata.creation_timestamp = float(rng.randint(0, 3))
