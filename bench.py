@@ -140,6 +140,11 @@ def main() -> None:
     from kube_batch_tpu.ops import enable_compilation_cache
 
     enable_compilation_cache()
+    # The bench validates the DEVICE path against the serial baseline on
+    # every row, including the tiny gang config — disable the production
+    # size floor that would route small snapshots to the serial allocator
+    # (the floor itself is covered by tests/test_xla_allocate.py).
+    os.environ.setdefault("KBT_MIN_DEVICE_PAIRS", "0")
     details = {}
     full_serial = os.environ.get("KBT_BENCH_FULL_SERIAL") == "1"
 

@@ -104,10 +104,41 @@ class XlaAllocateAction(Action):
         from kube_batch_tpu.ops.encode import encode_session
         from kube_batch_tpu.ops.kernels import result_of, solve_allocate_state
 
+        self.last_timings = {}  # never report a previous cycle's path
         if not _kernel_supported(ssn):
             log.info("conf outside kernel envelope; running serial allocate")
             self._fallback(ssn)
             return
+
+        mesh = self._resolve_mesh(ssn)
+
+        # Size floor: one device solve costs a fixed dispatch round trip
+        # (~0.1 s over a remote chip) regardless of payload, while the
+        # serial loop clears tiny snapshots in microseconds-per-pair —
+        # route (tasks x nodes) below the floor to the serial action
+        # (bit-exact float64, no device). A mesh *request* — even one
+        # that failed to resolve — is a statement of device intent and
+        # skips the floor (the multichip dryrun relies on this).
+        if mesh is None and not self._mesh_requested(ssn):
+            pend = sum(
+                len(j.task_status_index.get(TaskStatus.PENDING, {}))
+                for j in ssn.jobs.values()
+            )
+            if pend * max(len(ssn.nodes), 1) < self._min_device_pairs(ssn):
+                log.debug(
+                    "snapshot below the device size floor (%d pending x %d "
+                    "nodes); running serial allocate",
+                    pend,
+                    len(ssn.nodes),
+                )
+                import time as _time
+
+                t0 = _time.perf_counter()
+                self._fallback(ssn)
+                self.last_timings = {
+                    "serial_routed_s": _time.perf_counter() - t0
+                }
+                return
 
         import jax.numpy as jnp
 
@@ -154,7 +185,6 @@ class XlaAllocateAction(Action):
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
-        mesh = self._resolve_mesh(ssn)
         solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype, mesh)
 
         t0 = _time.perf_counter()
@@ -194,6 +224,34 @@ class XlaAllocateAction(Action):
             "solve_s": t_solve,
             "replay_s": _time.perf_counter() - t0,
         }
+
+    def _mesh_requested(self, ssn: Session) -> bool:
+        """True when the conf/env names a mesh at all — resolution may
+        still fail (bad backend, one device), but the operator asked for
+        the device path, so the size floor must not reroute to serial."""
+        spec = ssn.action_arguments.get(self.name, {}).get(
+            "mesh", os.environ.get("KBT_MESH", "")
+        )
+        return (spec or "").strip().lower() not in ("", "off", "none", "0", "1")
+
+    def _min_device_pairs(self, ssn: Session) -> int:
+        """(pending tasks x nodes) below which the serial action is the
+        faster allocator. Default 32768: at ~6 us/pair the serial loop
+        finishes in ~0.2 s, the break-even with the device round trip.
+        Conf `actionArguments: {xla_allocate: {min_device_pairs: N}}`
+        or env KBT_MIN_DEVICE_PAIRS overrides; 0 forces the device path
+        (how the parity suites pin the kernel under test)."""
+        spec = ssn.action_arguments.get(self.name, {}).get(
+            "min_device_pairs", os.environ.get("KBT_MIN_DEVICE_PAIRS", "")
+        )
+        try:
+            return int(spec)
+        except (TypeError, ValueError):
+            if str(spec).strip():
+                log.warning(
+                    "min_device_pairs=%r is not an integer; using default", spec
+                )
+            return 32768
 
     def _resolve_mesh(self, ssn: Session):
         """Conf-selected device mesh for the solve, or None (single-chip).

@@ -24,6 +24,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The parity suites exist to exercise the device kernels: disable the
+# size floor that would route their (deliberately small) snapshots to
+# the serial action in production.
+os.environ.setdefault("KBT_MIN_DEVICE_PAIRS", "0")
+
 # Persistent compile cache stays inside the repo (gitignored), not the
 # developer's $HOME: warm across local runs, easy to wipe, no pollution.
 os.environ.setdefault(

@@ -547,3 +547,61 @@ def test_drf_share_orders_jobs():
         return build_cluster(pods, nodes, [pg_fat, pg_thin], [build_queue("default")])
 
     assert_equivalent(mk, DEFAULT_TIERS_YAML)
+
+
+def test_small_snapshot_routes_serial(monkeypatch):
+    """Below the device size floor the action runs the serial allocate
+    (same result, no device round trip); 0 forces the device path (what
+    the rest of this suite relies on via conftest)."""
+    import kube_batch_tpu.actions.xla_allocate as XA
+
+    def mk():
+        pods = [
+            build_pod(name=f"p{i}", group_name="g", req=build_resource_list(cpu=1, memory="512Mi"))
+            for i in range(3)
+        ]
+        nodes = [build_node(f"n{i}", build_resource_list(cpu=4, memory="4Gi", pods=10)) for i in range(2)]
+        return build_cluster(pods, nodes, [build_pod_group("g", min_member=3)], [build_queue("default")])
+
+    monkeypatch.setenv("KBT_MIN_DEVICE_PAIRS", "32768")
+    action = XA.XlaAllocateAction()
+    cache = FakeCache(mk())
+    ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+    action.execute(ssn)
+    routed_binds = dict(cache.binder.binds)
+    assert "serial_routed_s" in action.last_timings  # serial path taken
+    close_session(ssn)
+
+    monkeypatch.setenv("KBT_MIN_DEVICE_PAIRS", "0")
+    action = XA.XlaAllocateAction()
+    cache = FakeCache(mk())
+    ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+    action.execute(ssn)
+    device_binds = dict(cache.binder.binds)
+    assert "solve_s" in action.last_timings  # device path taken
+    close_session(ssn)
+
+    assert routed_binds == device_binds and len(routed_binds) == 3
+
+
+def test_conf_selected_mesh_skips_size_floor(monkeypatch):
+    """An explicit mesh request is a statement of intent: the size floor
+    must not reroute it (the multichip dryrun depends on this)."""
+    import kube_batch_tpu.actions.xla_allocate as XA
+
+    monkeypatch.setenv("KBT_MIN_DEVICE_PAIRS", str(10**9))
+    monkeypatch.setenv("KBT_MESH", "cpu:2")
+    pods = [
+        build_pod(name=f"p{i}", group_name="g", req=build_resource_list(cpu=1, memory="512Mi"))
+        for i in range(4)
+    ]
+    nodes = [build_node(f"n{i}", build_resource_list(cpu=4, memory="4Gi", pods=10)) for i in range(2)]
+    cluster = build_cluster(pods, nodes, [build_pod_group("g", min_member=4)], [build_queue("default")])
+    action = XA.XlaAllocateAction()
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+    action.execute(ssn)
+    assert action.last_mesh_size == 2  # mesh engaged despite the floor
+    assert "serial_routed_s" not in action.last_timings
+    assert len(cache.binder.binds) == 4
+    close_session(ssn)
