@@ -3,6 +3,8 @@
 solve must agree exactly with the single-device solve, and the driver's
 dryrun contract must hold."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -66,6 +68,101 @@ def test_dryrun_multichip_contract():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_hermetic_to_poisoned_tpu():
+    """VERDICT r4 item 1: a wedged/unavailable TPU backend must not be
+    able to fail the virtual-CPU-mesh correctness check. Run the driver
+    contract (`__graft_entry__.py dryrun 8`) in a subprocess where the
+    ambient accelerator genuinely cannot initialize: the axon plugin is
+    never registered (its sitecustomize is gated on PALLAS_AXON_POOL_IPS)
+    and libtpu discovery points at a nonexistent library — so with
+    JAX_PLATFORMS naming a non-cpu backend, any unpinned backend lookup
+    raises instead of silently falling back."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # axon backend now unregistered
+    env["TPU_LIBRARY_PATH"] = "/nonexistent/libtpu.so"
+    env["JAX_PLATFORMS"] = "axon"  # unknown backend unless the dryrun pins cpu
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "__graft_entry__.py"), "dryrun", "8"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "dryrun_multichip ok" in out.stdout
+
+
+def test_dryrun_inprocess_path_touches_only_cpu():
+    """The in-process dryrun path (taken when the process is already
+    pinned to cpu, as the test/driver conftest does): replace every
+    non-cpu backend factory with a raising stub, so if ANY eager or
+    jitted op dispatches outside cpu, init fails loudly — a hard
+    guarantee independent of plugin internals."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(
+        """
+        import os
+        import sys
+        sys.path.insert(0, %r)
+        # Before any backend init: the forced device count must land on
+        # the cpu client the poisoned run will use.
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        import jax
+        import jax._src.xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        # Force lazy PJRT plugin discovery NOW (initializes only cpu,
+        # registers every entry-point plugin's factory) so the poison
+        # below also covers lazily-registered plugins.
+        xb.backends()
+
+        def _boom(*a, **k):
+            raise RuntimeError("poisoned: non-cpu backend initialized")
+
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                reg = xb._backend_factories[name]
+                try:
+                    poisoned = reg._replace(factory=_boom, fail_quietly=False)
+                except AttributeError:
+                    import dataclasses
+                    poisoned = dataclasses.replace(
+                        reg, factory=_boom, fail_quietly=False)
+                xb._backend_factories[name] = poisoned
+
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(8)
+        """
+        % _REPO
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # Forbid the subprocess fallback inside the scripted process: if the
+    # in-process hermetic gate regresses, dryrun must raise, not re-exec
+    # an unpoisoned child that would turn this test vacuously green.
+    env["KBT_DRYRUN_CHILD"] = "1"
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=_REPO,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "dryrun_multichip ok" in out.stdout
 
 
 def test_entry_contract():
