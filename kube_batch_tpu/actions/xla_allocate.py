@@ -880,9 +880,18 @@ class _Replayer:
                 # it is volume-less with volume_ready=True — no per-task
                 # checks, one bulk status flip, one bulk index move.
                 dispatched = list(allocated.values())
+                flipped = False
                 if _native is not None:
-                    _native.bulk_set_slot(dispatched, "status", BINDING)
-                else:
+                    try:
+                        _native.bulk_set_slot(dispatched, "status", BINDING)
+                        flipped = True
+                    except (TypeError, AttributeError):
+                        # TaskInfo variant without plain member slots, or a
+                        # mixed batch — same fallback as the bulk_assign
+                        # call site. A partial prefix flip is harmless: the
+                        # loop below re-sets every task to the same status.
+                        pass
+                if not flipped:
                     for task in dispatched:
                         task.status = BINDING
                 to_bind.extend(dispatched)

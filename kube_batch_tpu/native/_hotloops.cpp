@@ -169,6 +169,8 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
   }
 
   /* Decode row/nrow indices once, bounds-checked. */
+  Py_ssize_t n_seg = PyList_GET_SIZE(counts);
+  Py_ssize_t* seg_cnt = nullptr;  // freed at fail_ix (PyMem_Free(NULL) ok)
   Py_ssize_t* row_ix = (Py_ssize_t*)PyMem_Malloc(2 * n * sizeof(Py_ssize_t));
   if (row_ix == nullptr && n > 0) return PyErr_NoMemory();
   Py_ssize_t* nrow_ix = row_ix + n;
@@ -193,17 +195,61 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
     }
     const SlotCache& sc = g_task_slots;
 
-    /* Mutation-free prepass: homogeneous types + the volume guard. */
+    /* Mutation-free prepass: homogeneous types, the volume guard, and
+     * segment-count consistency — every error this function can raise
+     * is guaranteed pre-mutation, which is what the caller's "the
+     * prepass mutated nothing" fallback comment relies on. Counts are
+     * parsed ONCE here into a C array; the mutation loop below never
+     * touches the Python list again, so the guarantee is structural. */
+    seg_cnt = (Py_ssize_t*)PyMem_Malloc((n_seg > 0 ? n_seg : 1) *
+                                        sizeof(Py_ssize_t));
+    if (seg_cnt == nullptr) {
+      PyErr_NoMemory();
+      goto fail_ix;
+    }
+    {
+      Py_ssize_t total = 0;
+      for (Py_ssize_t s = 0; s < n_seg; s++) {
+        Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(counts, s));
+        if (cnt == -1 && PyErr_Occurred()) goto fail_ix;
+        if (cnt < 0 || cnt > n - total) {  // keeps total <= n: no overflow
+          PyErr_SetString(PyExc_ValueError, "segment count out of range");
+          goto fail_ix;
+        }
+        seg_cnt[s] = cnt;
+        total += cnt;
+      }
+      if (total != n) {
+        PyErr_SetString(PyExc_ValueError,
+                        "counts do not sum to the event total");
+        goto fail_ix;
+      }
+    }
     for (Py_ssize_t i = 0; i < n; i++) {
       PyObject* task = PyList_GET_ITEM(tasks, row_ix[i]);
       if (Py_TYPE(task) != sc.type) {
         PyErr_SetString(PyExc_TypeError, "mixed TaskInfo types in batch");
         goto fail_ix;
       }
+      PyObject* uid_pre = get_slot(task, sc.off[kUid]);
+      if (uid_pre == nullptr) {
+        // the mutation loop uses uid as a dict key — a NULL there would
+        // crash the interpreter mid-mutation
+        PyErr_SetString(PyExc_AttributeError, "task.uid slot unset");
+        goto fail_ix;
+      }
+      if (!PyUnicode_Check(uid_pre)) {
+        // non-str uid could raise at hash time inside the mutation loop
+        PyErr_SetString(PyExc_TypeError, "task.uid is not a str");
+        goto fail_ix;
+      }
       if (is_alloc[i]) {
         PyObject* pod = get_slot(task, sc.off[kPod]);
-        PyObject* vols =
-            pod ? PyObject_GetAttr(pod, g_volumes_name) : nullptr;
+        if (pod == nullptr) {
+          PyErr_SetString(PyExc_AttributeError, "task.pod slot unset");
+          goto fail_ix;
+        }
+        PyObject* vols = PyObject_GetAttr(pod, g_volumes_name);
         if (vols == nullptr) goto fail_ix;
         int truthy = PyObject_IsTrue(vols);
         Py_DECREF(vols);
@@ -217,13 +263,10 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
       }
     }
 
-    Py_ssize_t n_seg = PyList_GET_SIZE(counts);
     PyObject* out = PyList_New(n_seg);
     if (out == nullptr) goto fail_ix;
     Py_ssize_t i = 0;
     for (Py_ssize_t s = 0; s < n_seg; s++) {
-      Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(counts, s));
-      if (cnt == -1 && PyErr_Occurred()) goto fail_out;
       PyObject* alloc_d = PyDict_New();
       PyObject* pipe_d = PyDict_New();
       PyObject* pair = (alloc_d && pipe_d) ? PyTuple_Pack(2, alloc_d, pipe_d)
@@ -232,11 +275,7 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
       Py_XDECREF(pipe_d);
       if (pair == nullptr) goto fail_out;
       PyList_SET_ITEM(out, s, pair);
-      Py_ssize_t end = i + cnt;
-      if (end > n) {
-        PyErr_SetString(PyExc_ValueError, "counts exceed event total");
-        goto fail_out;
-      }
+      Py_ssize_t end = i + seg_cnt[s];  // prepass: sums to n exactly
       for (; i < end; i++) {
         PyObject* task = PyList_GET_ITEM(tasks, row_ix[i]);
         PyObject* uid = get_slot(task, sc.off[kUid]);
@@ -258,16 +297,14 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
         if (rc < 0) goto fail_out;
       }
     }
-    if (i != n) {
-      PyErr_SetString(PyExc_ValueError, "counts do not cover all events");
-      goto fail_out;
-    }
+    PyMem_Free(seg_cnt);
     PyMem_Free(row_ix);
     return out;
   fail_out:
     Py_DECREF(out);
   }
 fail_ix:
+  PyMem_Free(seg_cnt);
   PyMem_Free(row_ix);
   return nullptr;
 }
@@ -445,7 +482,11 @@ PyObject* collect_pending(PyObject*, PyObject* args) {
           goto fail;
         }
         PyObject* pod = get_slot(task, sc.off[kPod]);
-        PyObject* meta = pod ? PyObject_GetAttr(pod, meta_name) : nullptr;
+        if (pod == nullptr) {
+          PyErr_SetString(PyExc_AttributeError, "task.pod slot unset");
+          goto fail;
+        }
+        PyObject* meta = PyObject_GetAttr(pod, meta_name);
         PyObject* ts = meta ? PyObject_GetAttr(meta, ts_name) : nullptr;
         Py_XDECREF(meta);
         if (ts == nullptr) goto fail;
@@ -663,8 +704,14 @@ PyObject* extract_task_columns(PyObject*, PyObject* args) {
       long j = PyLong_AsLong(jrow);
       if (j == -1 && PyErr_Occurred()) goto done;
       job_out[i] = (int32_t)j;
-      int t1 = PyObject_IsTrue(get_slot(ir, rc.off[2]));
-      int t2 = PyObject_IsTrue(get_slot(rr, rc.off[2]));
+      PyObject* ir_sc = get_slot(ir, rc.off[2]);
+      PyObject* rr_sc = get_slot(rr, rc.off[2]);
+      if (ir_sc == nullptr || rr_sc == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "Resource scalars slot unset");
+        goto done;
+      }
+      int t1 = PyObject_IsTrue(ir_sc);
+      int t2 = PyObject_IsTrue(rr_sc);
       if (t1 < 0 || t2 < 0) goto done;
       hs[i] = (char)t1;
       rhs[i] = (char)t2;

@@ -30,6 +30,21 @@ def ensure(verbose: bool = False) -> str:
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(SOURCE):
         return out
     include = sysconfig.get_paths()["include"]
+    # A build killed mid-compile leaves its pid-stamped temp behind —
+    # sweep stale siblings before writing a fresh one. Age-gated so a
+    # concurrent builder's live temp (the reason temps are per-pid at
+    # all) is never yanked out from under its linker.
+    import time
+
+    cutoff = time.time() - 300
+    for stale in os.listdir(_DIR):
+        if stale.startswith("_hotloops") and stale.endswith(".tmp"):
+            p = os.path.join(_DIR, stale)
+            try:
+                if os.path.getmtime(p) < cutoff:
+                    os.unlink(p)
+            except OSError:
+                pass
     tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent builds race on os.replace, not on the write
     cmd = [
         "g++",

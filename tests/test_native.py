@@ -267,6 +267,80 @@ class TestCollectPending:
             )
 
 
+class TestHalfInitializedGuards:
+    """r4 advisor findings: unset __slots__ members must surface as
+    AttributeError (with the exception actually set), never a segfault
+    or a bare SystemError; counts inconsistencies must raise before any
+    mutation."""
+
+    def test_bulk_assign_counts_mismatch_raises_premutation(self):
+        tasks = _mk_tasks(3)
+        before = [(t.status, t.node_name, t.volume_ready) for t in tasks]
+        nt = [dict()]
+        for bad_counts in ([2], [4], [2, 2]):  # under / over / over-split
+            with pytest.raises(ValueError, match="count"):
+                lib.bulk_assign(
+                    tasks, [f"ns/p{i}" for i in range(3)], nt, ["n0"],
+                    [0, 1, 2], [0, 0, 0], bytes([1, 1, 1]), bad_counts,
+                    TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+                )
+            assert [(t.status, t.node_name, t.volume_ready) for t in tasks] == before
+            assert not nt[0]
+
+    def test_bulk_assign_null_pod_slot_raises(self):
+        tasks = _mk_tasks(2)
+        del tasks[1].pod  # unset the slot: C-level member is now NULL
+        nt = [dict()]
+        with pytest.raises(AttributeError, match="pod"):
+            lib.bulk_assign(
+                tasks, ["ns/p0", "ns/p1"], nt, ["n0"], [0, 1], [0, 0],
+                bytes([1, 1]), [2], TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+            )
+        assert not nt[0]  # prepass: nothing mutated
+
+    def test_bulk_assign_null_uid_slot_raises(self):
+        tasks = _mk_tasks(2)
+        del tasks[0].uid  # would be a NULL dict key in the mutation loop
+        nt = [dict()]
+        with pytest.raises(AttributeError, match="uid"):
+            lib.bulk_assign(
+                tasks, ["ns/p0", "ns/p1"], nt, ["n0"], [0, 1], [0, 0],
+                bytes([0, 0]), [2], TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+            )
+        assert not nt[0]
+        assert tasks[1].status is not TaskStatus.PIPELINED  # prepass: no mutation
+
+    def test_collect_pending_null_pod_slot_raises(self):
+        from kube_batch_tpu.api.job_info import JobInfo
+        from kube_batch_tpu.api.resource_info import (
+            MIN_MEMORY,
+            MIN_MILLI_CPU,
+            MIN_MILLI_SCALAR,
+        )
+
+        job = JobInfo(uid="j")
+        t = build_task(namespace="ns", name="ghost", req={"cpu": 1.0})
+        job.add_task_info(t)
+        del t.pod
+        with pytest.raises(AttributeError):
+            lib.collect_pending(
+                [job], TaskStatus.PENDING, MIN_MILLI_CPU, MIN_MEMORY,
+                MIN_MILLI_SCALAR,
+            )
+
+    def test_extract_task_columns_null_scalars_slot_raises(self):
+        t = build_task(namespace="ns", name="t0", req={"cpu": 1.0})
+        t.job = "j"
+        del t.resreq.scalars
+        req = np.zeros((1, 2), np.float32)
+        res = np.zeros((1, 2), np.float32)
+        job_out = np.zeros(1, np.int32)
+        hs = np.zeros(1, np.uint8)
+        rhs = np.zeros(1, np.uint8)
+        with pytest.raises(AttributeError, match="scalars"):
+            lib.extract_task_columns([t], {"j": 0}, req, res, job_out, hs, rhs)
+
+
 class TestBulkSetSlot:
     def test_sets_every_object(self):
         tasks = _mk_tasks(50)
