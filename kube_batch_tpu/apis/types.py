@@ -400,3 +400,29 @@ class PersistentVolumeClaim:
     @property
     def namespace(self) -> str:
         return self.metadata.namespace
+
+
+# ---------------------------------------------------------------------------
+# Lease (coordination.k8s.io/v1 shape, minimal)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Lease:
+    """Leader-election lease — the role the reference fills with a
+    ConfigMap resource lock (cmd/kube-batch/app/server.go:115-139,
+    resourcelock.ConfigMapsResourceLock). Arbitration happens inside the
+    store that holds the lease (ClusterStore.try_acquire_lease), so all
+    timestamps are the arbiter's clock — candidates never compare their
+    own clocks."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0  # arbiter clock, time.time()
+    renew_time: float = 0.0  # arbiter clock, time.time()
+    lease_transitions: int = 0  # leadership changes, k8s leaseTransitions
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

@@ -26,7 +26,9 @@ from typing import Any, Callable, Optional
 
 from kube_batch_tpu import log
 from kube_batch_tpu.apis.types import (
+    Lease,
     Node,
+    ObjectMeta,
     PersistentVolume,
     PersistentVolumeClaim,
     Pod,
@@ -46,14 +48,15 @@ PRIORITY_CLASSES = "priorityclasses"
 PVS = "persistentvolumes"
 PVCS = "persistentvolumeclaims"
 STORAGE_CLASSES = "storageclasses"
+LEASES = "leases"
 
 KINDS = (
     PODS, NODES, POD_GROUPS, QUEUES, PDBS, PRIORITY_CLASSES,
-    PVS, PVCS, STORAGE_CLASSES,
+    PVS, PVCS, STORAGE_CLASSES, LEASES,
 )
 
 # Kinds whose objects are cluster-scoped (keyed by name, not ns/name).
-_CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES, PVS, STORAGE_CLASSES}
+_CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES, PVS, STORAGE_CLASSES, LEASES}
 
 
 class AlreadyExists(KeyError):
@@ -208,6 +211,109 @@ class ClusterStore:
     def list(self, kind: str) -> list[Any]:
         with self._lock:
             return list(self._ks(kind).objects.values())
+
+    # -- leader-election arbiter -------------------------------------------
+
+    def try_acquire_lease(
+        self,
+        name: str,
+        identity: str,
+        lease_duration: float = 15.0,
+        now: Optional[float] = None,
+    ) -> Lease:
+        """Atomic acquire-or-renew of the named Lease; returns the lease
+        as it stands after the attempt (caller checks ``holder_identity``
+        to learn whether it leads). The arbitration ladder matches
+        client-go's leaderelection.tryAcquireOrRenew
+        (the reference drives it via leaderelection.RunOrDie,
+        cmd/kube-batch/app/server.go:127-139):
+
+        - no lease, or holder released (empty), or lease expired
+          (``now > renew_time + lease_duration_seconds``): take it —
+          transitions+1 when taking over from a different holder;
+        - held by us: renew (refresh renew_time);
+        - held by someone else and fresh: no mutation.
+
+        All times are THIS store's clock, so two candidates on hosts
+        with skewed clocks still agree on expiry."""
+        import math
+        import time as _time
+
+        if not identity:
+            # "" is the released sentinel — accepting it would report
+            # acquired=true while leaving the lease free for anyone
+            # (split-brain)
+            raise ValueError("lease identity must be non-empty")
+        if not (
+            isinstance(lease_duration, (int, float))
+            and math.isfinite(lease_duration)
+            and 0 < lease_duration <= 86400
+        ):
+            # NaN/inf never expire (blocking failover forever after the
+            # holder dies); <=0 is instantly stealable from a live leader
+            raise ValueError("lease_duration must be in (0, 86400] seconds")
+        now = _time.time() if now is None else now
+        with self._lock:
+            ks = self._ks(LEASES)
+            cur: Optional[Lease] = ks.objects.get(name)
+            if cur is not None and cur.holder_identity not in ("", identity):
+                expired = now > cur.renew_time + cur.lease_duration_seconds
+                if not expired:
+                    return cur
+            new = Lease(
+                metadata=ObjectMeta(name=name),
+                holder_identity=identity,
+                lease_duration_seconds=lease_duration,
+                acquire_time=(
+                    cur.acquire_time
+                    if cur is not None and cur.holder_identity == identity
+                    else now
+                ),
+                renew_time=now,
+                lease_transitions=(
+                    cur.lease_transitions
+                    + (1 if cur.holder_identity != identity else 0)
+                    if cur is not None
+                    else 0
+                ),
+            )
+            ks.objects[name] = new
+            if cur is None:
+                self._events.append(("add", list(ks.handlers), None, new))
+            else:
+                self._events.append(("update", list(ks.handlers), cur, new))
+        if cur is None or cur.holder_identity != identity:
+            log.infof("lease %s acquired by %s", name, identity)
+        self._drain()
+        return new
+
+    def release_lease(self, name: str, identity: str) -> Optional[Lease]:
+        """Graceful hand-off: the holder clears its identity so a standby
+        can take over immediately instead of waiting out the lease (the
+        client-go ReleaseOnCancel behavior). No-op unless ``identity``
+        currently holds the lease."""
+        if not identity:
+            # "" is the released sentinel; '""' == already-released holder
+            # would otherwise pass the holder check below
+            raise ValueError("lease identity must be non-empty")
+        with self._lock:
+            ks = self._ks(LEASES)
+            cur: Optional[Lease] = ks.objects.get(name)
+            if cur is None or cur.holder_identity != identity:
+                return cur
+            new = Lease(
+                metadata=cur.metadata,
+                holder_identity="",
+                lease_duration_seconds=cur.lease_duration_seconds,
+                acquire_time=cur.acquire_time,
+                renew_time=cur.renew_time,
+                lease_transitions=cur.lease_transitions,
+            )
+            ks.objects[name] = new
+            self._events.append(("update", list(ks.handlers), cur, new))
+        log.infof("lease %s released by %s", name, identity)
+        self._drain()
+        return new
 
     # -- typed conveniences (what tests and the simulator use) -------------
 

@@ -33,12 +33,18 @@ Pod JSON: ``{"name", "namespace", "group", "requests": {"cpu": 1,
 "namespace", "queue", "min_member"}``.
 
 HA: the reference elects a leader through a ConfigMap resource lock
-(server.go:96-137). The in-process equivalent is an OS file lock
-(``flock``) on ``--lock-file``: exactly one scheduler process per lock
-file runs the loop; the kernel releases the lock if the holder dies, so
-a standby flock-blocked on the same file takes over — the same
-single-active-scheduler guarantee, lease renewal included, without an
-API server to arbitrate.
+(server.go:96-137). Two tiers here:
+
+- single host (``--lock-file``): an OS file lock (``flock``) — exactly
+  one scheduler process per lock file runs the loop; the kernel releases
+  the lock if the holder dies and a blocked standby takes over;
+- cluster-wide (``--lease-url``): a Lease object in a shared
+  ClusterStore, renewed over the HTTP API with the reference's
+  15 s lease / 10 s renew-deadline / 5 s retry semantics
+  (``StoreLeaseElector``); any scheduler-API endpoint can arbitrate,
+  arbitration runs atomically under the arbiter's clock, and a leader
+  that cannot renew within the deadline exits fatally
+  (OnStoppedLeading glog.Fatalf parity, server.go:133-135).
 """
 
 from __future__ import annotations
@@ -113,6 +119,14 @@ SERIALIZERS = {
         "name": s.name,
         "provisioner": s.provisioner,
         "volume_binding_mode": s.volume_binding_mode.value,
+    },
+    "leases": lambda l: {
+        "name": l.name,
+        "holder": l.holder_identity,
+        "lease_duration": l.lease_duration_seconds,
+        "acquire_time": l.acquire_time,
+        "renew_time": l.renew_time,
+        "transitions": l.lease_transitions,
     },
 }
 
@@ -250,6 +264,220 @@ class LeaderElector:
             fcntl.flock(self._fh, fcntl.LOCK_UN)
             self._fh.close()
             self._fh = None
+
+
+class StoreLeaseElector:
+    """Cluster-wide leader election through a lease in a ClusterStore —
+    the distributed half of HA (the flock LeaderElector stays the
+    single-host fast path). Mirrors the reference's
+    leaderelection.RunOrDie over a ConfigMap resource lock
+    (cmd/kube-batch/app/server.go:115-139): lease_duration 15 s,
+    renew_deadline 10 s, retry_period 5 s, identity
+    ``hostname_pid_uuid``.
+
+    The arbiter is either an in-process ``ClusterStore`` or the HTTP
+    base URL of any scheduler-API server (``http://host:port``) — two
+    machines point at the same URL and exactly one leads. The entire
+    acquire-or-renew ladder executes atomically inside the arbiter under
+    the ARBITER's clock, so candidate clock skew cannot split the lease.
+
+    Renewal failures (network, arbiter down) are tolerated until
+    ``renew_deadline`` has passed since the last successful renewal;
+    then ``on_lost`` fires — process-level callers treat that as fatal,
+    exactly like the reference's OnStoppedLeading glog.Fatalf
+    (server.go:133-135)."""
+
+    def __init__(
+        self,
+        arbiter,
+        lease_name: str,
+        identity: str,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        retry_period: float = 5.0,
+    ) -> None:
+        self.arbiter = arbiter
+        self.lease_name = lease_name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+        self.is_leader = False
+
+    # -- one arbitration round-trip ----------------------------------------
+
+    def _post(self, verb: str, payload: dict, timeout: float) -> dict:
+        """One lease POST to the remote arbiter (shared by acquire and
+        release so the path/encoding scheme cannot drift apart)."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.arbiter.rstrip('/')}/apis/v1alpha1/leases/"
+            f"{urllib.parse.quote(self.lease_name, safe='')}/{verb}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def _try_acquire(self, timeout: float = 5.0) -> bool:
+        """One acquire-or-renew attempt; True iff we hold the lease.
+        ``timeout`` bounds the HTTP round-trip — the renewal loop shrinks
+        it to its remaining deadline budget so a hanging arbiter cannot
+        push loss-detection past the lease expiry."""
+        if isinstance(self.arbiter, str):
+            return bool(
+                self._post(
+                    "acquire",
+                    {
+                        "identity": self.identity,
+                        "lease_duration": self.lease_duration,
+                    },
+                    timeout,
+                ).get("acquired")
+            )
+        lease = self.arbiter.try_acquire_lease(
+            self.lease_name, self.identity, self.lease_duration
+        )
+        return lease.holder_identity == self.identity
+
+    def _release(self) -> None:
+        try:
+            if isinstance(self.arbiter, str):
+                self._post("release", {"identity": self.identity}, 5.0)
+            else:
+                self.arbiter.release_lease(self.lease_name, self.identity)
+        except Exception as e:  # best-effort: expiry will hand over anyway
+            log.errorf("lease release failed (standby waits out the lease): %s", e)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def acquire(self, blocking: bool = True) -> bool:
+        """Contend until the lease is ours (retry_period cadence, like
+        client-go's acquire loop). Non-blocking: one attempt."""
+        while not self._stop.is_set():
+            try:
+                if self._try_acquire():
+                    self.is_leader = True
+                    log.infof(
+                        "became leader: %s (lease %s)", self.identity, self.lease_name
+                    )
+                    return True
+            except Exception as e:
+                log.errorf("lease acquire attempt failed: %s", e)
+            if not blocking:
+                return False
+            self._stop.wait(self.retry_period)
+        return False
+
+    def start_renewing(self, on_lost) -> None:
+        """Background renewal at retry_period cadence; fires ``on_lost``
+        (once) if renew_deadline passes without a successful renewal or
+        the arbiter reports another holder. A separate watchdog enforces
+        the deadline on WALL time, independent of the renewal thread —
+        urllib's timeout is per-socket-operation, so an arbiter dripping
+        bytes could otherwise pin a renewal attempt (and loss detection)
+        past the lease expiry."""
+        lost_once = threading.Event()
+        lost_lock = threading.Lock()  # watchdog + renewal race on the set
+
+        def fire_lost(why: str) -> None:
+            with lost_lock:
+                if lost_once.is_set():
+                    return
+                lost_once.set()
+            self._lose(why, on_lost)
+
+        state = {"last_ok": time.monotonic()}
+
+        def watchdog() -> None:
+            while not self._stop.wait(
+                min(0.5, max(0.05, self.renew_deadline / 10))
+            ):
+                if time.monotonic() - state["last_ok"] >= self.renew_deadline:
+                    fire_lost("renew deadline exceeded (watchdog)")
+                    return
+
+        def loop() -> None:
+            last_ok = state["last_ok"]
+            wait = self.retry_period
+            while not self._stop.wait(wait):
+                # Deadline budget bounds each attempt (client-go bounds
+                # renewals with a renewDeadline-scoped context for the
+                # same reason): a hanging arbiter must not delay loss-
+                # detection past the point where the lease can expire
+                # under a standby.
+                remaining = self.renew_deadline - (time.monotonic() - last_ok)
+                if remaining <= 0:
+                    fire_lost("renew deadline exceeded before attempt")
+                    return
+                try:
+                    if self._try_acquire(timeout=max(0.5, min(5.0, remaining))):
+                        if lost_once.is_set():
+                            return  # watchdog already declared the loss
+                        last_ok = time.monotonic()
+                        state["last_ok"] = last_ok
+                        wait = self.retry_period
+                        continue
+                    # someone else holds it — we were fenced out
+                    fire_lost("lost to another holder")
+                    return
+                except Exception as e:
+                    log.errorf("lease renewal attempt failed: %s", e)
+                elapsed = time.monotonic() - last_ok
+                if elapsed >= self.renew_deadline:
+                    fire_lost("renew deadline exceeded")
+                    return
+                # After a failure, retry fast enough that several attempts
+                # fit inside the remaining budget — a single transient
+                # arbiter blip must not consume the whole deadline.
+                wait = max(
+                    0.05, min(self.retry_period, (self.renew_deadline - elapsed) / 3)
+                )
+
+        self._thread = threading.Thread(target=loop, name="kb-lease", daemon=True)
+        self._thread.start()
+        self._watchdog = threading.Thread(
+            target=watchdog, name="kb-lease-watchdog", daemon=True
+        )
+        self._watchdog.start()
+
+    def _lose(self, why: str, on_lost) -> None:
+        log.errorf("lease %s: %s", self.lease_name, why)
+        self.is_leader = False
+        on_lost()
+
+    def release(self) -> None:
+        """Stop renewing and hand the lease off gracefully. The release
+        POST is sent only once the renewal thread has provably finished —
+        an in-flight renewal landing after the release would silently
+        re-take the lease for a dying process; if the thread cannot be
+        joined in time we skip the hand-off and let the standby wait out
+        the lease (the crash path, safe)."""
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2)
+            self._watchdog = None
+        joined = True
+        if self._thread is not None:
+            self._thread.join(timeout=7)  # > max attempt timeout + retry
+            joined = not self._thread.is_alive()
+            if joined:
+                self._thread = None
+        if self.is_leader:
+            self.is_leader = False
+            if joined:
+                self._release()
+            else:
+                log.errorf(
+                    "lease %s: renewal still in flight at shutdown; skipping "
+                    "graceful release (standby waits out the lease)",
+                    self.lease_name,
+                )
 
 
 def _make_handler(server: "SchedulerServer"):
@@ -539,6 +767,59 @@ def _make_handler(server: "SchedulerServer"):
                     )
                     server.store.create_persistent_volume_claim(pvc)
                     self._reply(201, json.dumps({"namespace": namespace, "name": name}))
+                elif (
+                    self.path.startswith("/apis/v1alpha1/leases/")
+                    and self.path.endswith(("/acquire", "/release"))
+                ):
+                    # Leader-election arbitration endpoint: the whole
+                    # acquire-or-renew ladder runs atomically inside the
+                    # store under the ARBITER's clock (store.py
+                    # try_acquire_lease) — the role the reference's API
+                    # server plays for its ConfigMap resource lock
+                    # (cmd/kube-batch/app/server.go:115-139).
+                    parts = self.path.strip("/").split("/")
+                    if len(parts) != 5:
+                        # a raw '/' in the name would smear across path
+                        # segments and arbitrate the wrong scope —
+                        # electors quote(name, safe="") to prevent this
+                        raise ValueError(
+                            "lease path must be /apis/v1alpha1/leases/<name>/<verb> "
+                            "(percent-encode the name)"
+                        )
+                    # unquote restores the exact scope so HTTP and
+                    # in-process candidates on the same name contend on
+                    # the same lease
+                    lease_name, verb = urllib.parse.unquote(parts[3]), parts[4]
+                    if not lease_name:
+                        raise ValueError("lease name must be non-empty")
+                    identity = field(body, "identity", str, None, required=True)
+                    if verb == "acquire":
+                        duration = body.get("lease_duration", 15.0)
+                        if isinstance(duration, bool) or not isinstance(
+                            duration, (int, float)
+                        ):
+                            raise ValueError("lease_duration must be a number")
+                        lease = server.store.try_acquire_lease(
+                            lease_name, identity, float(duration)
+                        )
+                    else:
+                        lease = server.store.release_lease(lease_name, identity)
+                    if lease is None:
+                        self._reply(404, json.dumps({"error": "lease not found"}))
+                        return
+                    self._reply(
+                        200,
+                        json.dumps(
+                            {
+                                "name": lease_name,
+                                "holder": lease.holder_identity,
+                                "acquired": lease.holder_identity == identity,
+                                "lease_duration": lease.lease_duration_seconds,
+                                "renew_time": lease.renew_time,
+                                "transitions": lease.lease_transitions,
+                            }
+                        ),
+                    )
                 elif self.path == "/apis/v1alpha1/storageclasses":
                     from kube_batch_tpu.apis.types import (
                         StorageClass,
@@ -701,7 +982,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--lock-file",
         default="",
-        help="leader-election lock file (required with --leader-elect)",
+        help="leader-election lock file (single-host HA; required with "
+        "--leader-elect unless --lease-url is set)",
+    )
+    p.add_argument(
+        "--lease-url",
+        default="",
+        help="base URL of the lease arbiter (any scheduler-API endpoint, "
+        "e.g. http://store-host:8080) for cluster-wide leader election; "
+        "replaces --lock-file when set",
+    )
+    p.add_argument(
+        "--lease-name",
+        default="kube-batch",
+        help="lease object name under the arbiter (reference lock object "
+        "name, server.go:117)",
     )
     p.add_argument("--version", action="store_true", help="show version and quit")
     p.add_argument("-v", type=int, default=0, help="log verbosity (glog -v)")
@@ -713,8 +1008,10 @@ def run(argv: Optional[list[str]] = None) -> None:
     opt = build_parser().parse_args(argv)
     if opt.version:
         version.print_version_and_exit()
-    if opt.leader_elect and not opt.lock_file:
-        raise SystemExit("--lock-file must be set when --leader-elect is enabled")
+    if opt.leader_elect and not (opt.lock_file or opt.lease_url):
+        raise SystemExit(
+            "--lock-file or --lease-url must be set when --leader-elect is enabled"
+        )
     log.set_verbosity(opt.v)
 
     elector = None
@@ -724,9 +1021,26 @@ def run(argv: Optional[list[str]] = None) -> None:
         import uuid
 
         identity = f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
-        elector = LeaderElector(opt.lock_file, identity)
-        log.infof("waiting for leadership on %s ...", opt.lock_file)
-        elector.acquire(blocking=True)
+        if opt.lease_url:
+            elector = StoreLeaseElector(opt.lease_url, opt.lease_name, identity)
+            log.infof(
+                "waiting for leadership on lease %s at %s ...",
+                opt.lease_name, opt.lease_url,
+            )
+            elector.acquire(blocking=True)
+
+            def _lost() -> None:
+                # the reference's OnStoppedLeading is glog.Fatalf
+                # (server.go:133-135): a fenced-out leader must not keep
+                # mutating cluster state.
+                log.errorf("leaderelection lost")
+                os._exit(1)
+
+            elector.start_renewing(_lost)
+        else:
+            elector = LeaderElector(opt.lock_file, identity)
+            log.infof("waiting for leadership on %s ...", opt.lock_file)
+            elector.acquire(blocking=True)
 
     server = SchedulerServer(
         scheduler_name=opt.scheduler_name,
