@@ -114,7 +114,7 @@ def run_session(cluster, action_name: str, action_args=None):
     t0 = time.perf_counter()
     action.execute(ssn)
     dt = time.perf_counter() - t0
-    binds = len(cache.binder.binds)
+    binds = dict(cache.binder.binds)  # task -> node, the actual placements
     close_session(ssn)
     return dt, binds, dict(getattr(action, "last_timings", {}))
 
@@ -177,7 +177,7 @@ def main() -> None:
                     os.environ[k] = v
         entry = {
             "xla_s": round(xla_s, 4),
-            "binds": binds,
+            "binds": len(binds),
             "sessions": sessions,
             "p50_s": round(percentile(times, 50), 4),
         }
@@ -193,7 +193,15 @@ def main() -> None:
                 make_cluster, "allocate", warm=False, repeats=1
             )
             entry["serial_s"] = round(serial_s, 4)
-            assert s_binds == binds, f"{name}: serial={s_binds} xla={binds} binds"
+            # PLACEMENT equality, not just counts (VERDICT r4 item 4):
+            # with the comparison-dtype numerics (api/numerics.py) the
+            # f32 device solve and the serial float oracle are
+            # bind-for-bind identical — x64 off.
+            assert s_binds == binds, (
+                f"{name}: serial/xla placements diverge on "
+                f"{sum(1 for k in s_binds if k in binds and binds[k] != s_binds[k]) + len(set(binds) ^ set(s_binds))} tasks"
+            )
+            entry["placements_equal_serial"] = True
         elif serial == "cached":
             cached = SERIAL_MEASURED.get(name)
             if cached is not None:

@@ -1,0 +1,254 @@
+#!/usr/bin/env python3
+"""Static verification gate — the role of the reference's
+`make verify` (Makefile:14-18 -> hack/verify-gofmt.sh, verify-golint.sh,
+verify-boilerplate.sh), for a Python/C++ tree.
+
+Runs, in order:
+
+1. `compileall` — every tracked .py must byte-compile (syntax gate);
+2. `tabnanny` — no ambiguous indentation;
+3. an AST linter (stdlib-only, because this image ships no ruff/mypy
+   and installs are off): unused imports (F401), bare except (E722),
+   `== None` / `!= None` comparisons (E711), mutable default arguments
+   (B006), and f-strings without placeholders (F541);
+4. ruff + mypy when importable (CI images that carry them get the full
+   gate; their absence here degrades to the stdlib checks, loudly).
+
+Exit 0 iff every gate is clean. Usage:  python hack/verify.py
+"""
+
+from __future__ import annotations
+
+import ast
+import compileall
+import io
+import os
+import subprocess
+import sys
+import tabnanny
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["kube_batch_tpu", "tests", "bench.py", "__graft_entry__.py", "hack"]
+
+# Names a module may import without using (re-export / side-effect
+# registration idioms used deliberately in this codebase).
+SIDE_EFFECT_IMPORTS = {"kube_batch_tpu.actions", "kube_batch_tpu.plugins"}
+
+
+def py_files() -> list[str]:
+    out = []
+    for t in TARGETS:
+        p = os.path.join(REPO, t)
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__",)]
+            out.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
+    return sorted(out)
+
+
+class _Lint(ast.NodeVisitor):
+    """The checks: F401 / E722 / E711 / B006 / F541."""
+
+    def __init__(self, path: str, tree: ast.AST, source: str) -> None:
+        self.path = path
+        self.problems: list[tuple[int, str]] = []
+        self.imported: dict[str, tuple[int, str]] = {}  # name -> (line, full)
+        self.used: set[str] = set()
+        self.source = source
+        self.visit(tree)
+        self._flush_imports(tree)
+
+    def _flush_imports(self, tree: ast.AST) -> None:
+        exported = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        if isinstance(node.value, (ast.List, ast.Tuple)):
+                            exported = {
+                                e.value
+                                for e in node.value.elts
+                                if isinstance(e, ast.Constant)
+                            }
+        is_init = os.path.basename(self.path) == "__init__.py"
+        for name, (line, full) in self.imported.items():
+            if name in self.used or name in exported or full in SIDE_EFFECT_IMPORTS:
+                continue
+            if is_init:
+                continue  # package __init__ re-exports are the point
+            if name.startswith("_"):
+                continue
+            # a `# noqa` on the import line silences it, same as ruff
+            src_line = self.source.splitlines()[line - 1]
+            if "noqa" in src_line:
+                continue
+            self.problems.append((line, f"F401 unused import: {full}"))
+
+    # -- imports ------------------------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        # TYPE_CHECKING blocks import names for quoted annotations the
+        # runtime never loads — exempt them (ruff resolves the quoted
+        # usage instead; the stdlib linter exempts the block).
+        t = node.test
+        if (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+            isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+        ):
+            self.visit(t)  # the guard itself uses the TYPE_CHECKING name
+            for n in node.orelse:
+                self.visit(n)
+            return
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.imported[name] = (node.lineno, a.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directive, not a binding
+        for a in node.names:
+            if a.name == "*":
+                continue
+            name = a.asname or a.name
+            self.imported[name] = (node.lineno, f"{node.module}.{a.name}")
+
+    # -- usage --------------------------------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    # -- checks -------------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.problems.append((node.lineno, "E722 bare except"))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                (isinstance(comp, ast.Constant) and comp.value is None)
+            ):
+                self.problems.append(
+                    (node.lineno, "E711 comparison to None (use `is`)")
+                )
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.problems.append(
+                    (d.lineno, "B006 mutable default argument")
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # visit the expression only: a format spec is itself a synthetic
+        # JoinedStr and must not trip F541
+        self.visit(node.value)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.problems.append((node.lineno, "F541 f-string without placeholders"))
+        self.generic_visit(node)
+
+
+def run_ast_lint(files: list[str]) -> int:
+    n = 0
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, path)
+        except SyntaxError:
+            continue  # compileall already reported it
+        lint = _Lint(path, tree, source)
+        for line, msg in sorted(lint.problems):
+            rel = os.path.relpath(path, REPO)
+            print(f"{rel}:{line}: {msg}")
+            n += 1
+    return n
+
+
+def run_optional(tool: str, args: list[str]) -> int | None:
+    """Run ruff/mypy when the image carries them; None = unavailable."""
+    probe = subprocess.run(
+        [sys.executable, "-m", tool, "--version"],
+        capture_output=True,
+    )
+    if probe.returncode != 0:
+        return None
+    res = subprocess.run([sys.executable, "-m", tool, *args], cwd=REPO)
+    return res.returncode
+
+
+def main() -> int:
+    files = py_files()
+    failed = False
+
+    # 1. syntax
+    ok = compileall.compile_dir(
+        os.path.join(REPO, "kube_batch_tpu"), quiet=2, force=False
+    )
+    for single in files:
+        ok = compileall.compile_file(single, quiet=2) and ok
+    if not ok:
+        print("verify: compileall FAILED")
+        failed = True
+
+    # 2. indentation — tabnanny prints NannyNag diagnostics to STDOUT
+    # (only I/O/token errors go to stderr), so both streams gate
+    import contextlib
+
+    tab_problems = 0
+    for path in files:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+            tabnanny.check(path)
+        if buf.getvalue():
+            print(buf.getvalue().strip())
+            tab_problems += 1
+    if tab_problems:
+        print(f"verify: tabnanny flagged {tab_problems} file(s)")
+        failed = True
+
+    # 3. AST lint
+    n = run_ast_lint(files)
+    if n:
+        print(f"verify: AST lint found {n} problem(s)")
+        failed = True
+
+    # 4. the full gate, when available
+    for tool, args in (
+        ("ruff", ["check", "kube_batch_tpu"]),
+        ("mypy", ["--ignore-missing-imports", "kube_batch_tpu/api"]),
+    ):
+        rc = run_optional(tool, args)
+        if rc is None:
+            print(f"verify: {tool} unavailable in this image — skipped "
+                  "(stdlib gates above still ran)")
+        elif rc != 0:
+            print(f"verify: {tool} FAILED")
+            failed = True
+
+    print("verify:", "FAILED" if failed else "ok",
+          f"({len(files)} files)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

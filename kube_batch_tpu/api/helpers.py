@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from kube_batch_tpu.apis.types import Pod, PodPhase
 from kube_batch_tpu.api.resource_info import Resource
 from kube_batch_tpu.api.types import TaskStatus
@@ -53,10 +55,18 @@ def min_resource(l: Resource, r: Resource) -> Resource:
 
 def share(l: float, r: float) -> float:
     """DRF share division: 0/0 -> 0, x/0 -> 1
-    (reference api/helpers/helpers.go:43-60)."""
+    (reference api/helpers/helpers.go:43-60).
+
+    The quotient is computed in the comparison dtype (api/numerics.py):
+    f32 when the kernels solve f32, so share ties break identically in
+    the serial oracle and on device."""
     if r == 0:
         return 0.0 if l == 0 else 1.0
-    return l / r
+    from kube_batch_tpu.api.numerics import comparison_dtype
+
+    if comparison_dtype() is np.float64:
+        return l / r  # python floats ARE f64: no boxing on the fast path
+    return float(np.float32(l) / np.float32(r))
 
 
 def get_pod_resource_without_init_containers(pod: Pod) -> Resource:

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import time
 import uuid as _uuid
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 from kube_batch_tpu import metrics
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo

@@ -31,6 +31,8 @@
 
 #include <algorithm>
 #include <cstring>
+#include <string_view>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -817,6 +819,58 @@ PyObject* bulk_set_slot(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+/* ---- class_dedup --------------------------------------------------------- */
+
+/* class_dedup(keys) -> (first_bytes, inverse_bytes)
+ *
+ * Row-dedup of a C-contiguous 2-D buffer (any fixed-size dtype): one
+ * O(T) hash pass over row byte-spans, classes numbered in
+ * FIRST-OCCURRENCE order. Replaces np.unique's O(T log T) void-sort in
+ * the encoder's task-class dedup (ops/pallas_solve._class_inverse) —
+ * the difference is ~0.3 s at 400k tasks. Returns two bytes objects the
+ * caller np.frombuffer's: first (int64 row index per class) and inverse
+ * (int32 class id per row). Any consistent (first, inverse) pairing is
+ * valid for the kernel packing; class order itself carries no meaning. */
+PyObject* class_dedup(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+    return nullptr;
+  PyObject *first_b = nullptr, *inv_b = nullptr, *out = nullptr;
+  {
+    if (view.ndim != 2) {
+      PyErr_SetString(PyExc_TypeError, "class_dedup needs a 2-D buffer");
+      goto done;
+    }
+    Py_ssize_t T = view.shape[0];
+    Py_ssize_t row_bytes = view.shape[1] * view.itemsize;
+    inv_b = PyBytes_FromStringAndSize(nullptr, T * (Py_ssize_t)sizeof(int32_t));
+    if (inv_b == nullptr) goto done;
+    int32_t* inv = (int32_t*)PyBytes_AS_STRING(inv_b);
+    std::vector<int64_t> first;
+    first.reserve(256);
+    {
+      std::unordered_map<std::string_view, int32_t> seen;
+      seen.reserve((size_t)T * 2);
+      const char* base = (const char*)view.buf;
+      for (Py_ssize_t i = 0; i < T; i++) {
+        std::string_view row(base + i * row_bytes, (size_t)row_bytes);
+        auto [it, inserted] = seen.emplace(row, (int32_t)first.size());
+        if (inserted) first.push_back((int64_t)i);
+        inv[i] = it->second;
+      }
+    }
+    first_b = PyBytes_FromStringAndSize((const char*)first.data(),
+                                        first.size() * sizeof(int64_t));
+    if (first_b == nullptr) goto done;
+    out = PyTuple_Pack(2, first_b, inv_b);
+  }
+done:
+  Py_XDECREF(first_b);
+  Py_XDECREF(inv_b);
+  PyBuffer_Release(&view);
+  return out;
+}
+
 /* ---- module -------------------------------------------------------------- */
 
 PyMethodDef methods[] = {
@@ -831,6 +885,8 @@ PyMethodDef methods[] = {
      "Fill SoA request/limit/job/scalar-flag columns from TaskInfos."},
     {"extract_node_columns", extract_node_columns, METH_VARARGS,
      "Fill [A,N,R] cpu/mem columns from NodeInfo resource attributes."},
+    {"class_dedup", class_dedup, METH_O,
+     "Row-dedup a 2-D buffer: (first int64 bytes, inverse int32 bytes)."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -176,8 +176,19 @@ def _class_inverse(a: dict):
         axis=1,
     )
     key = np.ascontiguousarray(key)
-    void = key.view(np.dtype((np.void, key.dtype.itemsize * key.shape[1])))
-    _, first, inv = np.unique(void.ravel(), return_index=True, return_inverse=True)
+    from kube_batch_tpu.native import lib as _native
+
+    if _native is not None and hasattr(_native, "class_dedup"):
+        # O(T) hash pass, classes in first-occurrence order (~10x the
+        # void-sort below at 400k). Any consistent (first, inverse)
+        # pairing is equivalent — class order carries no meaning in the
+        # packed layout.
+        first_b, inv_b = _native.class_dedup(key)
+        first = np.frombuffer(first_b, np.int64)
+        inv = np.frombuffer(inv_b, np.int32).astype(np.int64)
+    else:
+        void = key.view(np.dtype((np.void, key.dtype.itemsize * key.shape[1])))
+        _, first, inv = np.unique(void.ravel(), return_index=True, return_inverse=True)
     _class_inv_slot = (inputs, (tports, first, inv))
     return tports, first, inv
 

@@ -26,7 +26,6 @@ terms as precomputed matrices (kube_batch_tpu.ops).
 
 from __future__ import annotations
 
-import math
 
 from kube_batch_tpu.api.job_info import TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
@@ -45,14 +44,22 @@ BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
 def least_requested_score(requested_cpu: float, requested_mem: float,
                           cap_cpu: float, cap_mem: float) -> int:
     """k8s LeastRequestedPriorityMap: per-dimension integer score
-    ((cap-req)*10)//cap, clamped at 0, averaged with integer division."""
+    ((cap-req)*10)//cap, clamped at 0, averaged with integer division.
+
+    Computed in the comparison dtype (api/numerics.py) like
+    balanced_resource_score: byte-denominated memory caps exceed the f32
+    integer range, so the floor boundary must land where the f32 device
+    kernels put it, not where exact float64 would."""
+    from kube_batch_tpu.api.numerics import comparison_dtype
+
+    dt = comparison_dtype()
 
     def dim(req: float, cap: float) -> int:
         if cap == 0:
             return 0
         if req > cap:
             return 0
-        return int(((cap - req) * MAX_PRIORITY) // cap)
+        return int(((dt(cap) - dt(req)) * dt(MAX_PRIORITY)) // dt(cap))
 
     return (dim(requested_cpu, cap_cpu) + dim(requested_mem, cap_mem)) // 2
 
@@ -60,16 +67,24 @@ def least_requested_score(requested_cpu: float, requested_mem: float,
 def balanced_resource_score(requested_cpu: float, requested_mem: float,
                             cap_cpu: float, cap_mem: float) -> int:
     """k8s BalancedResourceAllocationMap: 10 - |cpuF - memF| * 10 floored;
-    0 when either fraction >= 1."""
+    0 when either fraction >= 1.
 
-    def fraction(req: float, cap: float) -> float:
-        return req / cap if cap != 0 else 1.0
+    Fractions are off the integer grid, so every operation runs in the
+    comparison dtype (api/numerics.py): in f32 mode the truncation
+    boundary lands exactly where the device kernels put it, keeping node
+    choice bit-identical to the solve."""
+    from kube_batch_tpu.api.numerics import comparison_dtype
+
+    dt = comparison_dtype()
+
+    def fraction(req: float, cap: float):
+        return dt(req) / dt(cap) if cap != 0 else dt(1.0)
 
     cpu_f = fraction(requested_cpu, cap_cpu)
     mem_f = fraction(requested_mem, cap_mem)
     if cpu_f >= 1.0 or mem_f >= 1.0:
         return 0
-    return int(MAX_PRIORITY - math.fabs(cpu_f - mem_f) * MAX_PRIORITY)
+    return int(dt(MAX_PRIORITY) - abs(cpu_f - mem_f) * dt(MAX_PRIORITY))
 
 
 def node_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
@@ -92,12 +107,22 @@ def _sel_matches(selector: dict[str, str], labels: dict[str, str]) -> bool:
 
 
 def vectorized_least_balanced(req_cpu, req_mem, cap_cpu, cap_mem):
-    """Whole-node-axis float64 twins of least_requested_score /
+    """Whole-node-axis twins of least_requested_score /
     balanced_resource_score (identical floor/trunc semantics to the
     scalar formulas above) — shared by every vectorized scorer
     (actions/scan.py, plugins/tensorscore.py) so the numerically
-    sensitive parity lives in exactly one place."""
+    sensitive parity lives in exactly one place. Computed in the
+    comparison dtype (api/numerics.py) so truncation boundaries match
+    the device kernels' f32 in production."""
     import numpy as np
+
+    from kube_batch_tpu.api.numerics import comparison_dtype
+
+    dt = comparison_dtype()
+    req_cpu = np.asarray(req_cpu, dt)
+    req_mem = np.asarray(req_mem, dt)
+    cap_cpu = np.asarray(cap_cpu, dt)
+    cap_mem = np.asarray(cap_mem, dt)
 
     def least_dim(rq, cp):
         safe = np.where(cp == 0.0, 1.0, cp)

@@ -3,11 +3,8 @@
 reclaim_test.go:37): real model + real event handlers + fake write-side,
 one action.Execute, assert on FakeBinder.binds."""
 
-import pytest
-
 from kube_batch_tpu import actions  # noqa: F401  (registers actions)
 from kube_batch_tpu import plugins  # noqa: F401  (registers plugins)
-from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.apis.types import PodPhase
 from kube_batch_tpu.conf import parse_scheduler_conf
 from kube_batch_tpu.framework import close_session, get_action, open_session

@@ -7,10 +7,8 @@ import pytest
 from kube_batch_tpu.api.types import TaskStatus, ValidateResult
 from kube_batch_tpu.conf import PluginOption, Tier, apply_plugin_conf_defaults
 from kube_batch_tpu.framework import (
-    Arguments,
     EventHandler,
     Plugin,
-    Session,
     cleanup_plugin_builders,
     open_session,
     register_plugin_builder,

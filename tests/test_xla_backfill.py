@@ -10,8 +10,6 @@ serial chain."""
 
 import random
 
-import pytest
-
 from kube_batch_tpu import actions  # noqa: F401  (registers actions)
 from kube_batch_tpu import plugins  # noqa: F401  (registers plugins)
 from kube_batch_tpu.apis.types import (
