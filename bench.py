@@ -228,6 +228,15 @@ def main() -> None:
         serial="none",
         sessions=5,
     )
+    # The single-chip envelope row (VERDICT r4 item 5): a full session —
+    # encode + solve + replay + dispatch — at 8x the reference's headline
+    # scale, END TO END (replacing the README's former solve-only claim).
+    record(
+        "preempt_400k_40k",
+        lambda: preempt_mix(400_000, 40_000),
+        serial="none",
+        sessions=2,
+    )
 
     # -- mesh-path evidence (VERDICT r4 item 2) ---------------------------
     # (a) The conf-selected sharded solve on the 8-device virtual CPU

@@ -557,6 +557,7 @@ class _Replayer:
         self.node_idx = {name: i for i, name in enumerate(enc.node_names)}
         # Row-indexed hot lookups for the bulk loop.
         self.task_keys = [f"{t.namespace}/{t.name}" for t in enc.tasks]
+        self.row_of = {t.uid: r for r, t in enumerate(enc.tasks)}
         self.node_by_row = [ssn.nodes[name] for name in enc.node_names]
         self.node_tasks_by_row = [n.tasks for n in self.node_by_row]
         self.replayed = 0  # assignment events already applied
@@ -696,45 +697,89 @@ class _Replayer:
         compn = np.searchsorted(touched_n, nrows)
         n_alloc_vec = _segment_sum(compn[alloc], res[alloc], touched_n.size, R)
         n_pipe_vec = _segment_sum(compn[~alloc], res[~alloc], touched_n.size, R)
-        for k, nrow in enumerate(touched_n.tolist()):
+        # The dense cpu/mem columns update natively in one pass per pool
+        # (identical f64 adds, just without 60k interpreter round trips);
+        # scalar dimensions keep the Go nil-map semantics on the Python
+        # side and only run for the (rare) pools whose key sets are
+        # non-empty.
+        axpy_native = getattr(_native, "bulk_res_axpy", None) if _native else None
+
+        def axpy(objs, mat, sign) -> None:
+            # Per-POOL fallback: the native prepass guarantees failures
+            # are pre-mutation, so a variant Resource pool degrades to
+            # the Python loop without double-applying sibling pools.
+            if axpy_native is not None:
+                try:
+                    axpy_native(objs, mat, sign)
+                    return
+                except (TypeError, AttributeError):
+                    pass
+            for k, res in enumerate(objs):
+                res.milli_cpu += sign * float(mat[k, 0])
+                res.memory += sign * float(mat[k, 1])
+
+        touched_n_l = touched_n.tolist()
+        nodes_t = [self.node_by_row[nrow] for nrow in touched_n_l]
+        axpy([n.idle for n in nodes_t], n_alloc_vec, -1)
+        axpy([n.releasing for n in nodes_t], n_pipe_vec, -1)
+        axpy([n.used for n in nodes_t], n_alloc_vec + n_pipe_vec, 1)
+        for nrow in set(nkeys_alloc) | set(nkeys_pipe):
+            k = int(np.searchsorted(touched_n, nrow))
             node = self.node_by_row[nrow]
             ka = nkeys_alloc.get(nrow, empty)
             kp = nkeys_pipe.get(nrow, empty)
-            _res_sub(node.idle, n_alloc_vec[k], scalar_names, ka)
-            _res_sub(node.releasing, n_pipe_vec[k], scalar_names, kp)
-            _res_add(node.used, n_alloc_vec[k] + n_pipe_vec[k], scalar_names, ka | kp)
+            _res_scalars(node.idle, n_alloc_vec[k], scalar_names, ka, -1, nil_map=True)
+            _res_scalars(node.releasing, n_pipe_vec[k], scalar_names, kp, -1, nil_map=True)
+            _res_scalars(
+                node.used, n_alloc_vec[k] + n_pipe_vec[k], scalar_names, ka | kp, 1
+            )
 
         # -- job.allocated + drf/proportion event bookkeeping -------------
         touched_j = np.unique(tjob)
         compj = np.searchsorted(touched_j, tjob)
         j_tot = _segment_sum(compj, res, touched_j.size, R)
         j_alloc = _segment_sum(compj[alloc], res[alloc], touched_j.size, R)
-        jobs_with_alloc = set(np.unique(tjob[alloc]).tolist())
+        wa = np.unique(tjob[alloc])
         drf = self.drf
-        for k, jrow in enumerate(touched_j.tolist()):
-            job = self.enc.jobs[jrow]
-            if jrow in jobs_with_alloc:
-                self.alloc_jobs.add(job.uid)
-                _res_add(job.allocated, j_alloc[k], scalar_names, jkeys_alloc.get(jrow, empty))
-            if drf is not None:
-                _res_add(
-                    drf.job_attrs[job.uid].allocated, j_tot[k], scalar_names,
-                    jkeys_all.get(jrow, empty),
+        touched_j_l = touched_j.tolist()
+        jobs_t = [self.enc.jobs[jrow] for jrow in touched_j_l]
+        wa_pos = np.searchsorted(touched_j, wa)
+        jobs_wa = [jobs_t[p] for p in wa_pos.tolist()]
+        axpy([j.allocated for j in jobs_wa], j_alloc[wa_pos], 1)
+        self.alloc_jobs.update(j.uid for j in jobs_wa)
+        if drf is not None:
+            axpy([drf.job_attrs[j.uid].allocated for j in jobs_t], j_tot, 1)
+            self._touched_drf.update(j.uid for j in jobs_t)
+        for jrow in jkeys_alloc:
+            k = int(np.searchsorted(touched_j, jrow))
+            _res_scalars(
+                jobs_t[k].allocated, j_alloc[k], scalar_names,
+                jkeys_alloc[jrow], 1,
+            )
+        if drf is not None:
+            for jrow in jkeys_all:
+                k = int(np.searchsorted(touched_j, jrow))
+                _res_scalars(
+                    drf.job_attrs[jobs_t[k].uid].allocated, j_tot[k],
+                    scalar_names, jkeys_all[jrow], 1,
                 )
-                self._touched_drf.add(job.uid)
         prop = self.prop
         if prop is not None:
             qrow_arr = self.job_queue[tjob]
             touched_q = np.unique(qrow_arr)
             compq = np.searchsorted(touched_q, qrow_arr)
             q_tot = _segment_sum(compq, res, touched_q.size, R)
-            for k, qrow in enumerate(touched_q.tolist()):
-                qname = self.enc.queues[qrow].name
-                _res_add(
-                    prop.queue_attrs[qname].allocated, q_tot[k], scalar_names,
-                    qkeys.get(qrow, empty),
+            attrs_q = [
+                prop.queue_attrs[self.enc.queues[qrow].name]
+                for qrow in touched_q.tolist()
+            ]
+            axpy([a.allocated for a in attrs_q], q_tot, 1)
+            self._touched_prop.update(a.name for a in attrs_q)
+            for qrow in qkeys:
+                k = int(np.searchsorted(touched_q, qrow))
+                _res_scalars(
+                    attrs_q[k].allocated, q_tot[k], scalar_names, qkeys[qrow], 1
                 )
-                self._touched_prop.add(qname)
 
         # -- per-task surgery (status index, node task map, volumes) ------
         # Rows grouped per job (stable sort preserves assign order within
@@ -750,18 +795,20 @@ class _Replayer:
         ALLOCATED, PIPELINED = TaskStatus.ALLOCATED, TaskStatus.PIPELINED
         order = np.argsort(compj, kind="stable")
         counts = np.bincount(compj, minlength=touched_j.size).tolist()
-        rows_o = rows[order].tolist()
-        nrows_o = nrows[order].tolist()
+        rows_a = np.ascontiguousarray(rows[order], np.int64)
+        nrows_a = np.ascontiguousarray(nrows[order], np.int64)
         segments = None
         if _native is not None:
             try:
+                # index vectors go down as int64 buffers — no 2x200k
+                # PyLong boxing/unboxing round trip
                 segments = _native.bulk_assign(
                     self.enc.tasks,
                     self.task_keys,
                     self.node_tasks_by_row,
                     self.enc.node_names,
-                    rows_o,
-                    nrows_o,
+                    rows_a,
+                    nrows_a,
                     alloc[order].astype(np.uint8).tobytes(),
                     counts,
                     ALLOCATED,
@@ -777,19 +824,26 @@ class _Replayer:
                 segments = None
         if segments is None:
             segments = self._assign_segments_py(
-                rows_o, nrows_o, alloc[order].tolist(), counts
+                rows_a.tolist(), nrows_a.tolist(), alloc[order].tolist(), counts
             )
         for k, jrow in enumerate(touched_j.tolist()):
             alloc_d, pipe_d = segments[k]
             sidx = jobs_l[jrow].task_status_index
             pend = sidx.get(TaskStatus.PENDING)
             if pend is not None:
-                for uid in alloc_d:
-                    pend.pop(uid, None)
-                for uid in pipe_d:
-                    pend.pop(uid, None)
-                if not pend:
+                if len(alloc_d) + len(pipe_d) == len(pend):
+                    # this segment consumed the job's every remaining
+                    # pending task (uids are distinct and all drawn from
+                    # pend) — drop the bucket whole instead of 200k
+                    # one-at-a-time pops across the batch
                     del sidx[TaskStatus.PENDING]
+                else:
+                    for uid in alloc_d:
+                        pend.pop(uid, None)
+                    for uid in pipe_d:
+                        pend.pop(uid, None)
+                    if not pend:
+                        del sidx[TaskStatus.PENDING]
             if alloc_d:
                 d = sidx.get(ALLOCATED)
                 if d is None:
@@ -858,27 +912,12 @@ class _Replayer:
 
     # -- end of action -------------------------------------------------------
 
-    def finish(self, ready_cnt) -> None:
-        """Final share sync + the gang dispatch barrier."""
-        from kube_batch_tpu import metrics
-
+    def _finish_dispatch_py(self, ready_cnt_l, job_min_l, to_bind, pure_bulk,
+                            BINDING, bind_volumes, debug_on) -> None:
+        """The per-job dispatch barrier loop (Python twin of the native
+        bulk_dispatch fast path; also the only path handling host-stepped
+        jobs, whose tasks may carry volumes)."""
         ssn = self.ssn
-        if self.drf is not None:
-            for uid in self._touched_drf:
-                attr = self.drf.job_attrs[uid]
-                self.drf._update_share(attr)
-        if self.prop is not None:
-            for qname in self._touched_prop:
-                attr = self.prop.queue_attrs[qname]
-                self.prop._update_share(attr)
-
-        job_min = self.arrays["job_min"]
-        bind_volumes = ssn.cache.bind_volumes
-        BINDING = TaskStatus.BINDING
-        to_bind: list = []  # dispatched tasks, in dispatch order
-        pure_bulk: list = []  # pure-bulk gangs' tasks: ONE status flip below
-        ready_cnt_l = ready_cnt.tolist()  # one C pass, not 2 np getitems/job
-        job_min_l = np.asarray(job_min).tolist()
         for i, job in enumerate(self.enc.jobs):
             if job.uid not in self.alloc_jobs:
                 continue
@@ -899,9 +938,10 @@ class _Replayer:
                 binding = job.task_status_index.setdefault(BINDING, {})
                 binding.update(allocated)
                 job.task_status_index.pop(TaskStatus.ALLOCATED, None)
-                log.debug(
-                    "dispatched gang job %s (%d tasks)", job.uid, ready_cnt_l[i]
-                )
+                if debug_on:
+                    log.debug(
+                        "dispatched gang job %s (%d tasks)", job.uid, ready_cnt_l[i]
+                    )
                 continue
             dispatched = []
             failed = False
@@ -934,32 +974,154 @@ class _Replayer:
                 for task in dispatched:
                     allocated.pop(task.uid, None)
                     binding[task.uid] = task
-            log.debug("dispatched gang job %s (%d tasks)", job.uid, ready_cnt_l[i])
-        # One status flip for every pure-bulk gang in the action.
-        flipped = False
-        if pure_bulk and _native is not None:
+            if debug_on:
+                log.debug("dispatched gang job %s (%d tasks)", job.uid, ready_cnt_l[i])
+
+    def finish(self, ready_cnt) -> None:
+        """Final share sync + the gang dispatch barrier."""
+        from kube_batch_tpu import metrics
+
+        ssn = self.ssn
+        if self.drf is not None:
+            drf = self.drf
+            tot = drf.total_resource
+            attrs = [drf.job_attrs[uid] for uid in self._touched_drf]
+            if attrs and not tot.scalars:
+                # vectorized final share sync: same comparison-dtype
+                # division as helpers.share, one array op instead of
+                # 2 boxed divisions x 18k touched jobs
+                from kube_batch_tpu.api.numerics import comparison_dtype
+
+                dt = comparison_dtype()
+                a = np.array(
+                    [(at.allocated.milli_cpu, at.allocated.memory) for at in attrs],
+                    dtype=dt,
+                )
+                t = np.array([tot.milli_cpu, tot.memory], dtype=dt)
+                s = np.where(
+                    t == 0,
+                    np.where(a == 0, dt(0.0), dt(1.0)),
+                    a / np.where(t == 0, dt(1.0), t),
+                )
+                shares = np.maximum(np.maximum(s[:, 0], s[:, 1]), 0.0)
+                for at, sv in zip(attrs, shares.tolist()):
+                    at.share = sv
+            else:
+                for attr in attrs:
+                    drf._update_share(attr)
+        if self.prop is not None:
+            for qname in self._touched_prop:
+                attr = self.prop.queue_attrs[qname]
+                self.prop._update_share(attr)
+
+        job_min = self.arrays["job_min"]
+        bind_volumes = ssn.cache.bind_volumes
+        BINDING = TaskStatus.BINDING
+        to_bind: list = []  # dispatched tasks, in dispatch order
+        pure_bulk: list = []  # pure-bulk gangs' tasks: ONE status flip below
+        ready_cnt_l = ready_cnt.tolist()  # one C pass, not 2 np getitems/job
+        job_min_l = np.asarray(job_min).tolist()
+        import logging as _logging
+
+        debug_on = log.isEnabledFor(_logging.DEBUG)  # 2 calls/job otherwise
+        if (
+            not self.stepped_jobs
+            and not debug_on
+            and _native is not None
+            and hasattr(_native, "bulk_dispatch")
+        ):
+            # Every gang is pure-bulk (no volumes, no host steps): the
+            # whole dispatch barrier is one native pass — per GANG the
+            # ALLOCATED bucket moves wholesale under BINDING (dict move
+            # when no bucket exists), tasks returned in dispatch order.
+            alloc_jobs = self.alloc_jobs
+            mask = bytes(
+                1
+                if (job.uid in alloc_jobs and ready_cnt_l[i] >= job_min_l[i])
+                else 0
+                for i, job in enumerate(self.enc.jobs)
+            )
             try:
-                _native.bulk_set_slot(pure_bulk, "status", BINDING)
-                flipped = True
+                to_bind = _native.bulk_dispatch(
+                    self.enc.jobs, mask, TaskStatus.ALLOCATED, BINDING
+                )
+                pure_bulk = to_bind
             except (TypeError, AttributeError):
-                # TaskInfo variant without plain member slots, or a mixed
-                # batch — same fallback as the bulk_assign call site. A
-                # partial prefix flip is harmless: the loop below re-sets
-                # every task to the same status.
-                pass
-        if pure_bulk and not flipped:
-            for task in pure_bulk:
-                task.status = BINDING
+                to_bind, pure_bulk = [], []
+                self._finish_dispatch_py(
+                    ready_cnt_l, job_min_l, to_bind, pure_bulk, BINDING,
+                    bind_volumes, debug_on,
+                )
+        else:
+            self._finish_dispatch_py(
+                ready_cnt_l, job_min_l, to_bind, pure_bulk, BINDING,
+                bind_volumes, debug_on,
+            )
+        # Status flip + bind columns (rows / created / keys / hostnames)
+        # in ONE native pass over the dispatch list; Python fallback does
+        # the same in separate steps. The flip covers every dispatched
+        # task — stepped-path tasks are already BINDING, re-setting the
+        # identical value is a no-op.
+        rows_b = created = keys = hostnames = None
+        if to_bind:
+            if _native is not None and hasattr(_native, "finish_columns"):
+                try:
+                    rb, cb, keys, hostnames = _native.finish_columns(
+                        to_bind, self.row_of, self.task_keys, BINDING
+                    )
+                    rows_b = np.frombuffer(rb, np.int64)
+                    created = np.frombuffer(cb, np.float64)
+                except (TypeError, AttributeError):
+                    rows_b = created = keys = hostnames = None
+            if rows_b is None:
+                # flip the pure-bulk gangs (a partial native prefix flip
+                # is harmless: same value re-set)
+                flipped = False
+                if pure_bulk and _native is not None:
+                    try:
+                        _native.bulk_set_slot(pure_bulk, "status", BINDING)
+                        flipped = True
+                    except (TypeError, AttributeError):
+                        pass
+                if pure_bulk and not flipped:
+                    for task in pure_bulk:
+                        task.status = BINDING
+                row_of = self.row_of
+                tk = self.task_keys
+                rows_b = np.fromiter(
+                    (row_of.get(t.uid, -1) for t in to_bind),
+                    np.int64,
+                    count=len(to_bind),
+                )
+                created = np.fromiter(
+                    (t.pod.metadata.creation_timestamp for t in to_bind),
+                    np.float64,
+                    count=len(to_bind),
+                )
+                keys = [
+                    tk[r] if r >= 0 else f"{t.namespace}/{t.name}"
+                    for t, r in zip(to_bind, rows_b.tolist())
+                ]
+                hostnames = [t.node_name for t in to_bind]
         # Bulk bind: one cache mutex acquisition + one async write batch
         # for the whole action's dispatches (the replay-diet half of
         # VERDICT r3 item 8 — per-task cache.bind was the replay's
         # single largest cost at 50k).
-        bind_many = getattr(ssn.cache, "bind_many", None)
-        if bind_many is not None:
-            bind_many([(t, t.node_name) for t in to_bind])
-        else:
-            for t in to_bind:
-                ssn.cache.bind(t, t.node_name)
+        if to_bind:
+            keyed_bind = getattr(ssn.cache, "bind_many_keyed", None)
+            bind_many = getattr(ssn.cache, "bind_many", None)
+            if keyed_bind is not None:
+                # parallel-list form: no 200k (task, host) tuple builds
+                keyed_bind(to_bind, hostnames, keys)
+            elif bind_many is not None:
+                pairs = list(zip(to_bind, hostnames))
+                if _accepts_keys(bind_many):
+                    bind_many(pairs, keys=keys)
+                else:
+                    bind_many(pairs)
+            else:
+                for t, h in zip(to_bind, hostnames):
+                    ssn.cache.bind(t, h)
         if to_bind:
             # e2e scheduling latency per dispatched pod, as one vector op
             # instead of a 50k-iteration max() loop. Each task's latency
@@ -971,23 +1133,28 @@ class _Replayer:
             # dispatch time, exactly as the serial path would have.
             import time as _time
 
-            row_of = {t.uid: r for r, t in enumerate(self.enc.tasks)}
-            rows_b = np.fromiter(
-                (row_of.get(t.uid, -1) for t in to_bind),
-                np.int64,
-                count=len(to_bind),
-            )
             decided = np.where(
-                rows_b >= 0, self.decided_at[rows_b], _time.time()
-            )
-            created = np.fromiter(
-                (t.pod.metadata.creation_timestamp for t in to_bind),
-                np.float64,
-                count=len(to_bind),
+                rows_b >= 0, self.decided_at[np.maximum(rows_b, 0)], _time.time()
             )
             metrics.update_task_schedule_durations(
                 np.maximum(0.0, decided - created)
             )
+
+
+def _accepts_keys(bind_many) -> bool:
+    """Signature-probe for the keys= extension — catching TypeError
+    around the CALL would misread an internal TypeError raised after
+    partial submission as 'no keys support' and double-submit the
+    batch."""
+    import inspect
+
+    try:
+        params = inspect.signature(bind_many).parameters
+    except (TypeError, ValueError):
+        return False
+    return "keys" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 
 def _segment_sum(seg_ids, vecs, n_segments: int, R: int) -> np.ndarray:
@@ -1031,6 +1198,21 @@ def _res_add(res, vec, scalar_names, keys) -> None:
     res.memory += float(vec[1])
     for k in keys:
         res.scalars[k] = res.scalars.get(k, 0.0) + float(vec[2 + scalar_names.index(k)])
+
+
+def _res_scalars(res, vec, scalar_names, keys, sign, nil_map: bool = False) -> None:
+    """Scalar-dimension half of _res_add/_res_sub, for when the dense
+    cpu/mem columns already went through native bulk_res_axpy. With
+    ``nil_map`` the receiver's empty scalar map stays empty
+    (resource_info.go:151-153 sub semantics); adds create entries."""
+    if not keys or np.ndim(vec) == 0:
+        return
+    if nil_map and not res.scalars:
+        return
+    for k in keys:
+        res.scalars[k] = res.scalars.get(k, 0.0) + sign * float(
+            vec[2 + scalar_names.index(k)]
+        )
 
 
 def new() -> Action:

@@ -146,21 +146,33 @@ class Resource:
                 return False
         return True
 
-    def less_equal(self, rr: "Resource") -> bool:
+    def less_equal(self, rr: "Resource", dtype=None) -> bool:
         """Less-or-equal within epsilon per dimension — the admission check
         (reference resource_info.go:255-278). Go nil-map parity: a scalar
         entry on the left with no scalars at all on the right fails, even
-        a zero-valued one."""
-        if not (
-            self.milli_cpu < rr.milli_cpu or abs(rr.milli_cpu - self.milli_cpu) < MIN_MILLI_CPU
-        ):
+        a zero-valued one.
+
+        ``dtype`` (optional, e.g. numpy.float32) quantizes BOTH operands
+        before comparing — the proportion overused/reclaimable gates pass
+        the comparison dtype (api/numerics.py) so the serial gate rounds
+        exactly as the f32 device gate does; one-sided rounding of a
+        water-filled deserved against an on-grid allocated could
+        otherwise flip the gate between the two paths."""
+        if dtype is None:
+            lc, rc_, lm, rm = self.milli_cpu, rr.milli_cpu, self.memory, rr.memory
+        else:
+            lc, rc_ = float(dtype(self.milli_cpu)), float(dtype(rr.milli_cpu))
+            lm, rm = float(dtype(self.memory)), float(dtype(rr.memory))
+        if not (lc < rc_ or abs(rc_ - lc) < MIN_MILLI_CPU):
             return False
-        if not (self.memory < rr.memory or abs(rr.memory - self.memory) < MIN_MEMORY):
+        if not (lm < rm or abs(rm - lm) < MIN_MEMORY):
             return False
         for name, q in self.scalars.items():
             if not rr.scalars:
                 return False
             rrq = rr.scalars.get(name, 0.0)
+            if dtype is not None:
+                q, rrq = float(dtype(q)), float(dtype(rrq))
             if not (q < rrq or abs(rrq - q) < MIN_MILLI_SCALAR):
                 return False
         return True

@@ -833,7 +833,7 @@ class SchedulerCache:
             pod = task.pod
         self._submit_write(self._do_bind, pod, hostname, task)
 
-    def bind_many(self, pairs: list) -> None:
+    def bind_many(self, pairs: list, keys=None) -> None:
         """Bulk bind for the replay path: the per-bind net effect of
         `bind()` under ONE mutex acquisition and ONE async write
         submission (the reference fires a goroutine per pod,
@@ -842,7 +842,11 @@ class SchedulerCache:
         [(TaskInfo, hostname)]; a pair whose job/task/host vanished from
         the mirror (concurrent delete events run under this same mutex)
         routes through errTasks instead of aborting the batch, and
-        per-pod write failures still resync individually."""
+        per-pod write failures still resync individually. ``keys`` is
+        the replay's precomputed key hint — this binder resolves
+        jobs/tasks itself, so it is accepted for protocol compatibility
+        and unused."""
+        del keys
         resolved = []
         failed = []
         with self._mutex:

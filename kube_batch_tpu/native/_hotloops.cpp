@@ -47,6 +47,8 @@ const char* const kSlotNames[kNumSlots] = {
     "node_name", "status", "priority", "volume_ready", "pod",
 };
 constexpr int kUid = 0;
+constexpr int kName = 2;
+constexpr int kNamespace = 3;
 constexpr int kNodeName = 6;
 constexpr int kStatus = 7;
 constexpr int kPriority = 8;
@@ -146,21 +148,63 @@ PyObject* g_volumes_name = nullptr;  // interned "volumes"
  * A task with pod.volumes on an Allocated event needs the volume
  * binder (host-side assume) — detected in a mutation-free prepass and
  * raised as ValueError so the caller falls back cleanly. */
+/* rows/nrows arrive as Python int lists OR int64 buffers (numpy
+ * arrays) — the buffer form spares the caller 2n PyLong boxings and
+ * this function 2n unboxings on the 200k-event replay path. */
+static int read_index_vec(PyObject* obj, Py_ssize_t* out, Py_ssize_t n,
+                          Py_ssize_t limit, const char* what) {
+  if (PyList_Check(obj)) {
+    if (PyList_GET_SIZE(obj) != n) {
+      PyErr_Format(PyExc_ValueError, "%s length mismatch", what);
+      return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      Py_ssize_t v = PyLong_AsSsize_t(PyList_GET_ITEM(obj, i));
+      if (v == -1 && PyErr_Occurred()) return -1;
+      if (v < 0 || v >= limit) {
+        PyErr_SetString(PyExc_IndexError, "row index out of range");
+        return -1;
+      }
+      out[i] = v;
+    }
+    return 0;
+  }
+  Py_buffer view;
+  if (PyObject_GetBuffer(obj, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+    return -1;
+  int rc = -1;
+  if (view.ndim != 1 || view.shape[0] != n || view.itemsize != 8 ||
+      view.format == nullptr ||
+      !(view.format[0] == 'l' || view.format[0] == 'q')) {
+    PyErr_Format(PyExc_TypeError, "%s must be an int64 vector of length %zd",
+                 what, n);
+  } else {
+    const int64_t* src = (const int64_t*)view.buf;
+    rc = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (src[i] < 0 || src[i] >= limit) {
+        PyErr_SetString(PyExc_IndexError, "row index out of range");
+        rc = -1;
+        break;
+      }
+      out[i] = (Py_ssize_t)src[i];
+    }
+  }
+  PyBuffer_Release(&view);
+  return rc;
+}
+
 PyObject* bulk_assign(PyObject*, PyObject* args) {
   PyObject *tasks, *tkeys, *node_tasks, *node_names, *rows, *nrows;
   PyObject *allocs, *counts, *st_alloc, *st_pipe;
-  if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!SO!OO", &PyList_Type, &tasks,
+  if (!PyArg_ParseTuple(args, "O!O!O!O!OOSO!OO", &PyList_Type, &tasks,
                         &PyList_Type, &tkeys, &PyList_Type, &node_tasks,
-                        &PyList_Type, &node_names, &PyList_Type, &rows,
-                        &PyList_Type, &nrows, &allocs, &PyList_Type, &counts,
+                        &PyList_Type, &node_names, &rows,
+                        &nrows, &allocs, &PyList_Type, &counts,
                         &st_alloc, &st_pipe))
     return nullptr;
 
-  Py_ssize_t n = PyList_GET_SIZE(rows);
-  if (PyList_GET_SIZE(nrows) != n || PyBytes_GET_SIZE(allocs) != n) {
-    PyErr_SetString(PyExc_ValueError, "rows/nrows/allocs length mismatch");
-    return nullptr;
-  }
+  Py_ssize_t n = PyBytes_GET_SIZE(allocs);
   const char* is_alloc = PyBytes_AS_STRING(allocs);
   Py_ssize_t n_tasks = PyList_GET_SIZE(tasks);
   Py_ssize_t n_nodes = PyList_GET_SIZE(node_tasks);
@@ -176,17 +220,9 @@ PyObject* bulk_assign(PyObject*, PyObject* args) {
   Py_ssize_t* row_ix = (Py_ssize_t*)PyMem_Malloc(2 * n * sizeof(Py_ssize_t));
   if (row_ix == nullptr && n > 0) return PyErr_NoMemory();
   Py_ssize_t* nrow_ix = row_ix + n;
-  for (Py_ssize_t i = 0; i < n; i++) {
-    Py_ssize_t r = PyLong_AsSsize_t(PyList_GET_ITEM(rows, i));
-    Py_ssize_t nr = PyLong_AsSsize_t(PyList_GET_ITEM(nrows, i));
-    if ((r == -1 || nr == -1) && PyErr_Occurred()) goto fail_ix;
-    if (r < 0 || r >= n_tasks || nr < 0 || nr >= n_nodes) {
-      PyErr_SetString(PyExc_IndexError, "row index out of range");
-      goto fail_ix;
-    }
-    row_ix[i] = r;
-    nrow_ix[i] = nr;
-  }
+  if (read_index_vec(rows, row_ix, n, n_tasks, "rows") < 0 ||
+      read_index_vec(nrows, nrow_ix, n, n_nodes, "nrows") < 0)
+    goto fail_ix;
 
   {
     /* Slot offsets for this TaskInfo type (cached across calls). */
@@ -819,6 +855,254 @@ PyObject* bulk_set_slot(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+/* ---- bulk_dispatch ------------------------------------------------------- */
+
+/* bulk_dispatch(jobs, mask, ALLOCATED, BINDING) -> list[task]
+ *
+ * The gang dispatch barrier's pure-bulk half (xla_allocate finish()):
+ * for each job whose mask byte is 1, move task_status_index[ALLOCATED]
+ * wholesale under [BINDING] and append the moved tasks (index insertion
+ * order) to the returned flat list. When no BINDING bucket exists the
+ * dict itself moves — one setitem+delitem per GANG, not per task. The
+ * caller owns the readiness/purity decisions baked into mask and flips
+ * the returned tasks' status afterwards (bulk_set_slot). */
+PyObject* bulk_dispatch(PyObject*, PyObject* args) {
+  PyObject *jobs, *mask_b, *alloc_key, *binding_key;
+  if (!PyArg_ParseTuple(args, "O!SOO", &PyList_Type, &jobs, &mask_b,
+                        &alloc_key, &binding_key))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(jobs);
+  if (PyBytes_GET_SIZE(mask_b) != n) {
+    PyErr_SetString(PyExc_ValueError, "mask length mismatch");
+    return nullptr;
+  }
+  const char* mask = PyBytes_AS_STRING(mask_b);
+  /* Mutation-free prepass: every masked job must expose a dict status
+   * index BEFORE any bucket moves — the caller's Python fallback
+   * re-walks all jobs assuming nothing was dispatched yet; a mid-loop
+   * failure after partial moves would strand those gangs unbound. */
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!mask[i]) continue;
+    PyObject* sidx = PyObject_GetAttr(PyList_GET_ITEM(jobs, i), g_idx_name);
+    if (sidx == nullptr) return nullptr;
+    int ok = PyDict_Check(sidx);
+    Py_DECREF(sidx);
+    if (!ok) {
+      PyErr_SetString(PyExc_TypeError, "task_status_index is not a dict");
+      return nullptr;
+    }
+  }
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!mask[i]) continue;
+    PyObject* sidx = PyObject_GetAttr(PyList_GET_ITEM(jobs, i), g_idx_name);
+    if (sidx == nullptr) goto fail;
+    if (!PyDict_Check(sidx)) {
+      Py_DECREF(sidx);
+      PyErr_SetString(PyExc_TypeError, "task_status_index is not a dict");
+      goto fail;
+    }
+    {
+      PyObject* allocated = PyDict_GetItemWithError(sidx, alloc_key);
+      if (allocated == nullptr) {
+        Py_DECREF(sidx);
+        if (PyErr_Occurred()) goto fail;
+        continue;  // nothing Allocated on this job
+      }
+      if (!PyDict_Check(allocated) || PyDict_GET_SIZE(allocated) == 0) {
+        Py_DECREF(sidx);
+        continue;
+      }
+      Py_ssize_t pos = 0;
+      PyObject *k, *task;
+      while (PyDict_Next(allocated, &pos, &k, &task)) {
+        if (PyList_Append(out, task) < 0) {
+          Py_DECREF(sidx);
+          goto fail;
+        }
+      }
+      PyObject* binding = PyDict_GetItemWithError(sidx, binding_key);
+      int rc;
+      if (binding == nullptr) {
+        if (PyErr_Occurred()) {
+          Py_DECREF(sidx);
+          goto fail;
+        }
+        rc = PyDict_SetItem(sidx, binding_key, allocated);  // dict moves
+      } else {
+        rc = PyDict_Merge(binding, allocated, 1);
+      }
+      if (rc == 0) rc = PyDict_DelItem(sidx, alloc_key);
+      Py_DECREF(sidx);
+      if (rc < 0) goto fail;
+    }
+  }
+  return out;
+fail:
+  Py_DECREF(out);
+  return nullptr;
+}
+
+/* ---- finish_columns ------------------------------------------------------ */
+
+/* finish_columns(tasks, row_of, task_keys, new_status) ->
+ *     (rows_bytes int64, created_bytes f64, keys list, hostnames list)
+ *
+ * One C pass over the dispatch list building everything finish() needs:
+ * per task its encoder row (-1 if this encode never saw it), its pod
+ * creation timestamp, its "ns/name" bind key (borrowed from task_keys
+ * when encoded, built fresh otherwise) and its node_name — replacing
+ * four separate 200k-iteration Python comprehensions on the replay's
+ * critical path. When ``new_status`` is not None every task's status
+ * slot is set to it in the same pass (the gang-dispatch flip; nothing
+ * observes status between the dispatch loop and the bind). */
+PyObject* finish_columns(PyObject*, PyObject* args) {
+  PyObject *tasks, *row_of, *task_keys, *new_status;
+  if (!PyArg_ParseTuple(args, "O!O!O!O", &PyList_Type, &tasks, &PyDict_Type,
+                        &row_of, &PyList_Type, &task_keys, &new_status))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(tasks);
+  Py_ssize_t n_keys = PyList_GET_SIZE(task_keys);
+  PyObject *rows_b = nullptr, *created_b = nullptr, *keys = nullptr,
+           *hostnames = nullptr, *out = nullptr;
+  rows_b = PyBytes_FromStringAndSize(nullptr, n * (Py_ssize_t)sizeof(int64_t));
+  created_b = PyBytes_FromStringAndSize(nullptr, n * (Py_ssize_t)sizeof(double));
+  keys = PyList_New(n);
+  hostnames = PyList_New(n);
+  if (!rows_b || !created_b || !keys || !hostnames) goto fail;
+  {
+    int64_t* rows = (int64_t*)PyBytes_AS_STRING(rows_b);
+    double* created = (double*)PyBytes_AS_STRING(created_b);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* task = PyList_GET_ITEM(tasks, i);
+      PyTypeObject* tp = Py_TYPE(task);
+      if (g_task_slots.type != tp && resolve_slots(tp, &g_task_slots) < 0)
+        goto fail;
+      const SlotCache& sc = g_task_slots;
+      PyObject* uid = get_slot(task, sc.off[kUid]);
+      if (uid == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "task.uid slot unset");
+        goto fail;
+      }
+      PyObject* row_o = PyDict_GetItemWithError(row_of, uid);
+      if (row_o == nullptr && PyErr_Occurred()) goto fail;
+      Py_ssize_t row = -1;
+      if (row_o != nullptr) {
+        row = PyLong_AsSsize_t(row_o);
+        if (row == -1 && PyErr_Occurred()) goto fail;
+      }
+      rows[i] = (int64_t)row;
+      PyObject* pod = get_slot(task, sc.off[kPod]);
+      if (pod == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "task.pod slot unset");
+        goto fail;
+      }
+      PyObject* meta = PyObject_GetAttr(pod, g_meta_name);
+      PyObject* ts = meta ? PyObject_GetAttr(meta, g_ts_name) : nullptr;
+      Py_XDECREF(meta);
+      if (ts == nullptr) goto fail;
+      created[i] = PyFloat_AsDouble(ts);
+      Py_DECREF(ts);
+      if (created[i] == -1.0 && PyErr_Occurred()) goto fail;
+      PyObject* key;
+      if (row >= 0 && row < n_keys) {
+        key = Py_NewRef(PyList_GET_ITEM(task_keys, row));
+      } else {
+        PyObject* ns = get_slot(task, sc.off[kNamespace]);
+        PyObject* nm = get_slot(task, sc.off[kName]);
+        if (ns == nullptr || nm == nullptr) {
+          PyErr_SetString(PyExc_AttributeError, "task ns/name slot unset");
+          goto fail;
+        }
+        key = PyUnicode_FromFormat("%U/%U", ns, nm);
+        if (key == nullptr) goto fail;
+      }
+      PyList_SET_ITEM(keys, i, key);
+      PyObject* node_name = get_slot(task, sc.off[kNodeName]);
+      if (node_name == nullptr) {
+        PyErr_SetString(PyExc_AttributeError, "task.node_name slot unset");
+        goto fail;
+      }
+      PyList_SET_ITEM(hostnames, i, Py_NewRef(node_name));
+      if (new_status != Py_None) set_slot(task, sc.off[kStatus], new_status);
+    }
+  }
+  out = PyTuple_Pack(4, rows_b, created_b, keys, hostnames);
+fail:
+  Py_XDECREF(rows_b);
+  Py_XDECREF(created_b);
+  Py_XDECREF(keys);
+  Py_XDECREF(hostnames);
+  return out;
+}
+
+/* ---- bulk_res_axpy ------------------------------------------------------- */
+
+/* bulk_res_axpy(res_objs, deltas, sign): for each Resource object,
+ *   milli_cpu += sign * deltas[i,0];  memory += sign * deltas[i,1]
+ * (deltas a C-contiguous [n,>=2] float64 buffer). The scalar-map
+ * dimensions keep their Go nil-map semantics on the Python side — this
+ * covers only the two dense dimensions every node/job touches. */
+PyObject* bulk_res_axpy(PyObject*, PyObject* args) {
+  PyObject *objs, *buf_o;
+  int sign;
+  if (!PyArg_ParseTuple(args, "O!Oi", &PyList_Type, &objs, &buf_o, &sign))
+    return nullptr;
+  Py_buffer view;
+  if (PyObject_GetBuffer(buf_o, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+    return nullptr;
+  PyObject* ret = nullptr;
+  {
+    Py_ssize_t n = PyList_GET_SIZE(objs);
+    if (view.ndim != 2 || view.shape[0] < n || view.shape[1] < 2 ||
+        view.itemsize != 8 || view.format == nullptr ||
+        view.format[0] != 'd') {
+      PyErr_SetString(PyExc_TypeError, "deltas must be [n,>=2] float64");
+      goto done;
+    }
+    Py_ssize_t R = view.shape[1];
+    const double* d = (const double*)view.buf;
+    /* Mutation-free prepass: one homogeneous slot type, both dense
+     * slots set on every element — a heterogeneous Resource variant
+     * raises BEFORE any pool is touched. The caller's per-pool Python
+     * fallback relies on failures being pre-mutation (a half-applied
+     * delta would double-count under the fallback). */
+    if (n > 0) {
+      PyTypeObject* rtp = Py_TYPE(PyList_GET_ITEM(objs, 0));
+      if (g_res_slots.type != rtp && resolve_res_slots(rtp, &g_res_slots) < 0)
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* res = PyList_GET_ITEM(objs, i);
+      if (Py_TYPE(res) != g_res_slots.type) {
+        PyErr_SetString(PyExc_TypeError, "mixed Resource types in batch");
+        goto done;
+      }
+      double cpu, mem;  // also proves float-convertibility pre-mutation
+      if (res_cpu_mem(res, g_res_slots, &cpu, &mem) < 0) goto done;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* res = PyList_GET_ITEM(objs, i);
+      const ResSlotCache& rc = g_res_slots;
+      double cpu, mem;
+      if (res_cpu_mem(res, rc, &cpu, &mem) < 0) goto done;
+      PyObject* nc = PyFloat_FromDouble(cpu + sign * d[i * R + 0]);
+      if (nc == nullptr) goto done;
+      set_slot(res, rc.off[0], nc);
+      Py_DECREF(nc);
+      PyObject* nm = PyFloat_FromDouble(mem + sign * d[i * R + 1]);
+      if (nm == nullptr) goto done;
+      set_slot(res, rc.off[1], nm);
+      Py_DECREF(nm);
+    }
+    ret = Py_NewRef(Py_None);
+  }
+done:
+  PyBuffer_Release(&view);
+  return ret;
+}
+
 /* ---- class_dedup --------------------------------------------------------- */
 
 /* class_dedup(keys) -> (first_bytes, inverse_bytes)
@@ -887,6 +1171,12 @@ PyMethodDef methods[] = {
      "Fill [A,N,R] cpu/mem columns from NodeInfo resource attributes."},
     {"class_dedup", class_dedup, METH_O,
      "Row-dedup a 2-D buffer: (first int64 bytes, inverse int32 bytes)."},
+    {"bulk_dispatch", bulk_dispatch, METH_VARARGS,
+     "Move masked jobs' ALLOCATED buckets under BINDING; return the tasks."},
+    {"finish_columns", finish_columns, METH_VARARGS,
+     "Rows/created/keys/pairs for the dispatch list in one pass."},
+    {"bulk_res_axpy", bulk_res_axpy, METH_VARARGS,
+     "Resource.milli_cpu/memory += sign*deltas[i] over a list."},
     {nullptr, nullptr, 0, nullptr},
 };
 
