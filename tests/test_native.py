@@ -394,3 +394,122 @@ class TestActionUsesNative:
         monkeypatch.setattr(XA, "_native", None)
         python_binds = run()
         assert native_binds == python_binds and len(native_binds) > 0
+
+
+class TestR5PrepassContracts:
+    """r5 native additions: every mutating entry point must fail
+    PRE-mutation so the Python fallbacks never double-apply."""
+
+    def test_bulk_dispatch_bad_index_raises_premutation(self):
+        from kube_batch_tpu.api.job_info import JobInfo
+
+        good = JobInfo(uid="g")
+        t = build_task(namespace="ns", name="t0", req={"cpu": 1.0})
+        good.add_task_info(t)
+        good.update_task_status(t, TaskStatus.ALLOCATED)
+
+        class Weird:
+            task_status_index = "not-a-dict"
+
+        with pytest.raises(TypeError, match="task_status_index"):
+            lib.bulk_dispatch(
+                [good, Weird()], bytes([1, 1]),
+                TaskStatus.ALLOCATED, TaskStatus.BINDING,
+            )
+        # prepass fired before any bucket moved
+        assert TaskStatus.ALLOCATED in good.task_status_index
+        assert TaskStatus.BINDING not in good.task_status_index
+
+    def test_bulk_dispatch_moves_buckets_and_returns_tasks(self):
+        from kube_batch_tpu.api.job_info import JobInfo
+
+        jobs = []
+        for j in range(3):
+            job = JobInfo(uid=f"j{j}")
+            for i in range(4):
+                t = build_task(namespace="ns", name=f"j{j}t{i}", req={"cpu": 1.0})
+                job.add_task_info(t)
+                job.update_task_status(t, TaskStatus.ALLOCATED)
+            jobs.append(job)
+        out = lib.bulk_dispatch(
+            jobs, bytes([1, 0, 1]), TaskStatus.ALLOCATED, TaskStatus.BINDING
+        )
+        assert [t.name for t in out] == [
+            f"j{j}t{i}" for j in (0, 2) for i in range(4)
+        ]
+        for j, job in enumerate(jobs):
+            if j == 1:
+                assert TaskStatus.ALLOCATED in job.task_status_index
+            else:
+                assert TaskStatus.ALLOCATED not in job.task_status_index
+                assert len(job.task_status_index[TaskStatus.BINDING]) == 4
+
+    def test_bulk_dispatch_merges_into_existing_binding_bucket(self):
+        from kube_batch_tpu.api.job_info import JobInfo
+
+        job = JobInfo(uid="j")
+        pre = build_task(namespace="ns", name="pre", req={"cpu": 1.0})
+        job.add_task_info(pre)
+        job.update_task_status(pre, TaskStatus.BINDING)  # existing bucket
+        t = build_task(namespace="ns", name="t0", req={"cpu": 1.0})
+        job.add_task_info(t)
+        job.update_task_status(t, TaskStatus.ALLOCATED)
+        out = lib.bulk_dispatch(
+            [job], bytes([1]), TaskStatus.ALLOCATED, TaskStatus.BINDING
+        )
+        assert [x.name for x in out] == ["t0"]
+        binding = job.task_status_index[TaskStatus.BINDING]
+        assert set(binding) == {pre.uid, t.uid}  # merged, not replaced
+        assert TaskStatus.ALLOCATED not in job.task_status_index
+
+    def test_bulk_res_axpy_mixed_types_raise_premutation(self):
+        from kube_batch_tpu.api.resource_info import Resource
+
+        a = Resource(milli_cpu=1000.0, memory=2048.0)
+        b = object()  # not a Resource at all
+        deltas = np.asarray([[100.0, 10.0], [100.0, 10.0]], np.float64)
+        with pytest.raises(TypeError):
+            lib.bulk_res_axpy([a, b], deltas, 1)
+        assert a.milli_cpu == 1000.0 and a.memory == 2048.0  # untouched
+
+    def test_bulk_res_axpy_applies_dense_dims(self):
+        from kube_batch_tpu.api.resource_info import Resource
+
+        rs = [Resource(milli_cpu=1000.0, memory=2048.0) for _ in range(3)]
+        deltas = np.asarray(
+            [[100.0, 10.0], [200.0, 20.0], [300.0, 30.0]], np.float64
+        )
+        lib.bulk_res_axpy(rs, deltas, -1)
+        assert [r.milli_cpu for r in rs] == [900.0, 800.0, 700.0]
+        assert [r.memory for r in rs] == [2038.0, 2028.0, 2018.0]
+
+    def test_finish_columns_matches_python_builds(self):
+        tasks = _mk_tasks(5)
+        for i, t in enumerate(tasks):
+            t.node_name = f"node-{i}"
+            t.pod.metadata.creation_timestamp = 100.0 + i
+        row_of = {t.uid: r for r, t in enumerate(tasks)}
+        task_keys = [f"{t.namespace}/{t.name}" for t in tasks]
+        rb, cb, keys, hostnames = lib.finish_columns(
+            tasks, row_of, task_keys, TaskStatus.BINDING
+        )
+        assert np.frombuffer(rb, np.int64).tolist() == list(range(5))
+        assert np.frombuffer(cb, np.float64).tolist() == [100.0 + i for i in range(5)]
+        assert keys == task_keys
+        assert hostnames == [f"node-{i}" for i in range(5)]
+        assert all(t.status is TaskStatus.BINDING for t in tasks)
+
+    def test_finish_columns_unencoded_task_keys_lazily(self):
+        tasks = _mk_tasks(2)
+        for t in tasks:
+            t.node_name = "n0"
+        row_of = {tasks[0].uid: 0}  # tasks[1] unknown to this encode
+        rb, cb, keys, hostnames = lib.finish_columns(
+            tasks, row_of, ["ns/p0"], None
+        )
+        rows = np.frombuffer(rb, np.int64).tolist()
+        assert rows == [0, -1]
+        assert keys == ["ns/p0", "ns/p1"]
+        # None = no flip: status must be UNCHANGED (build_task default)
+        assert tasks[0].status is TaskStatus.PENDING
+        assert tasks[1].status is TaskStatus.PENDING
