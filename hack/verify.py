@@ -12,7 +12,11 @@ Runs, in order:
    `== None` / `!= None` comparisons (E711), mutable default arguments
    (B006), and f-strings without placeholders (F541);
 4. ruff + mypy when importable (CI images that carry them get the full
-   gate; their absence here degrades to the stdlib checks, loudly).
+   gate; their absence here degrades to the stdlib checks, loudly);
+5. the chaos smoke (kube_batch_tpu.faults.smoke): one injected fault per
+   subsystem — solver, native boundary, cache write, watch hub, lease
+   elector — plus a seeded cache-mutation-detector violation, each
+   through a real scheduling path, asserting binds still land.
 
 Exit 0 iff every gate is clean. Usage:  python hack/verify.py
 """
@@ -244,6 +248,21 @@ def main() -> int:
         elif rc != 0:
             print(f"verify: {tool} FAILED")
             failed = True
+
+    # 5. chaos smoke — the failure drills must actually work here
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        KBT_MIN_DEVICE_PAIRS="0",
+        KBT_CACHE_MUTATION_DETECTOR="1",
+    )
+    env.pop("KBT_FAULTS", None)  # a drill armed in the shell would skew it
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.faults.smoke"], cwd=REPO, env=env
+    )
+    if res.returncode != 0:
+        print("verify: chaos smoke FAILED")
+        failed = True
 
     print("verify:", "FAILED" if failed else "ok",
           f"({len(files)} files)")

@@ -48,6 +48,8 @@ import os
 import jax  # noqa: F401  -- fail registration, not mid-cycle, when absent
 import numpy as np
 
+from kube_batch_tpu import faults, metrics
+from kube_batch_tpu import log as _glog
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import Session
@@ -56,6 +58,25 @@ from kube_batch_tpu.actions.envelope import kernel_supported as _kernel_supporte
 from kube_batch_tpu.native import lib as _native
 
 log = logging.getLogger("kube_batch_tpu.actions.xla_allocate")
+
+
+class _DeviceSolveError(RuntimeError):
+    """Every device tier failed (or the XLA twin's breaker rejected the
+    cycle mid-solve): the caller degrades to serial for this cycle."""
+
+
+def _nonfinite_inputs(arrays: dict) -> list[str]:
+    """Names of float solver inputs carrying NaN/Inf. One reduction per
+    array (any non-finite value propagates through sum; a finite array
+    overflowing the sum is an overflow worth flagging too) — cheap next
+    to the solve, and the guard that turns a poisoned score tensor into
+    a logged serial cycle instead of silently wrong placements."""
+    bad = []
+    for name, v in arrays.items():
+        a = np.asarray(v)
+        if a.dtype.kind == "f" and not np.isfinite(a.sum()):
+            bad.append(name)
+    return bad
 
 
 def _nodeorder_weights(ssn: Session) -> tuple[float, float, float, float]:
@@ -159,6 +180,23 @@ class XlaAllocateAction(Action):
 
         import time as _time
 
+        # Degradation ladder (kube_batch_tpu.faults): the XLA twin is the
+        # device floor — every other device tier falls back onto it — so
+        # with its breaker open the whole device path sits the cycle out
+        # and serial (the bottom rung, the correctness oracle) runs. The
+        # breaker recovers through half-open probes, unlike the previous
+        # one-way exception fallback.
+        ladder = faults.solver_ladder
+        if not ladder.allow("xla"):
+            log.warning(
+                "device-solve breaker open; running serial allocate for this cycle"
+            )
+            metrics.register_degraded_cycle("serial", "breaker_open")
+            t0 = _time.perf_counter()
+            self._fallback(ssn)
+            self.last_timings = {"serial_degraded_s": _time.perf_counter() - t0}
+            return
+
         order = [o.name for t in ssn.tiers for o in t.plugins]
         enable_drf = "drf" in order
         enable_proportion = "proportion" in order
@@ -183,38 +221,66 @@ class XlaAllocateAction(Action):
         arrays["w_aff"] = dtype(w_aff)
         arrays["w_podaff"] = dtype(w_podaff)
 
+        # Fault point solve.nan: a poisoned score tensor, the failure the
+        # finite guard below exists to catch.
+        if faults.should_fire("solve.nan"):
+            arrays["w_least"] = dtype(float("nan"))
+        bad = _nonfinite_inputs(arrays)
+        if bad:
+            log.error(
+                "non-finite solver inputs (%s); running serial allocate for "
+                "this cycle", ", ".join(bad),
+            )
+            metrics.register_degraded_cycle("serial", "nonfinite")
+            t0 = _time.perf_counter()
+            self._fallback(ssn)
+            self.last_timings = {"serial_degraded_s": _time.perf_counter() - t0}
+            return
+
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
         solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype, mesh)
 
         t0 = _time.perf_counter()
-        state = solve_fn(None)
-        while int(state.paused_at) >= 0:
-            # Segmented hybrid: sync the session up to the pause point,
-            # serial-step the host-only task, resume the kernel.
-            s = jax.tree_util.tree_map(np.array, state)  # writable host copy
-            replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
-            s = self._host_step(ssn, enc, arrays, replay, s)
-            if enc.interpod_active:
-                # the host-stepped pod carries pod-affinity terms; once
-                # resident it shifts every group's InterPodAffinity score
-                from kube_batch_tpu.ops.encode import compute_pod_sc
+        try:
+            state = solve_fn(None)
+            while int(state.paused_at) >= 0:
+                # Segmented hybrid: sync the session up to the pause point,
+                # serial-step the host-only task, resume the kernel.
+                s = jax.tree_util.tree_map(np.array, state)  # writable host copy
+                replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
+                s = self._host_step(ssn, enc, arrays, replay, s)
+                if enc.interpod_active:
+                    # the host-stepped pod carries pod-affinity terms; once
+                    # resident it shifts every group's InterPodAffinity score
+                    from kube_batch_tpu.ops.encode import compute_pod_sc
 
-                arrays["pod_sc"] = compute_pod_sc(
-                    enc.task_reps,
-                    ssn.nodes,
-                    enc.node_names,
-                    arrays["pod_sc"].shape[1],
-                    dtype,
-                )
-            state = solve_fn(s)
+                    arrays["pod_sc"] = compute_pod_sc(
+                        enc.task_reps,
+                        ssn.nodes,
+                        enc.node_names,
+                        arrays["pod_sc"].shape[1],
+                        dtype,
+                    )
+                state = solve_fn(s)
 
-        result = result_of(state)
-        # all three result vectors come off-device here: the transfer is
-        # part of the solve's device round-trip, not of the replay
-        assign_pos = np.asarray(result.assign_pos)
-        assigned_node = np.asarray(result.assigned_node)
-        assigned_kind = np.asarray(result.assigned_kind)
+            result = result_of(state)
+            # all three result vectors come off-device here: the transfer is
+            # part of the solve's device round-trip, not of the replay
+            assign_pos = np.asarray(result.assign_pos)
+            assigned_node = np.asarray(result.assigned_node)
+            assigned_kind = np.asarray(result.assigned_kind)
+        except _DeviceSolveError as e:
+            # Bottom of the ladder: serial finishes the cycle. Any
+            # already-replayed host-step segments stand — serial allocate
+            # simply continues over the remaining pending tasks, the same
+            # session semantics as a mixed actions string.
+            log.error("device solve failed (%s); degrading to serial allocate", e)
+            metrics.register_degraded_cycle("serial", "solve_failed")
+            t0 = _time.perf_counter()
+            self._fallback(ssn)
+            self.last_timings = {"serial_degraded_s": _time.perf_counter() - t0}
+            return
         t_solve = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
@@ -344,8 +410,37 @@ class XlaAllocateAction(Action):
         Live InterPodAffinity scores no longer force the XLA kernel: the
         Pallas solver re-folds its affinity static whenever the action
         refreshes arrays["pod_sc"] between pause/resume segments
-        (pallas_solve.fold_affinity_scores)."""
+        (pallas_solve.fold_affinity_scores).
+
+        Tier health flows through the faults.solver_ladder breakers: a
+        pallas failure (init or solve) both falls back within the cycle
+        AND records against the pallas breaker, so a persistently broken
+        tier sits out its backoff instead of being retried blindly every
+        cycle (and, unlike the old `solver = None`, is probed again once
+        the backoff elapses). An XLA-twin failure raises
+        _DeviceSolveError so execute() degrades the cycle to serial."""
         from kube_batch_tpu.ops.kernels import solve_allocate_state
+
+        ladder = faults.solver_ladder
+
+        def _xla_solve(st):
+            # The device floor. Failures (organic or the solve.xla fault
+            # point) feed the xla breaker and surface as _DeviceSolveError
+            # — execute() runs serial for the cycle; the breaker's
+            # half-open probe re-tries the device path later.
+            try:
+                if faults.should_fire("solve.xla"):
+                    raise faults.FaultInjected("solve.xla")
+                out = solve_allocate_state(
+                    arrays, st, enable_drf=enable_drf,
+                    enable_proportion=enable_proportion,
+                )
+            except Exception as e:
+                log.exception("XLA solve failed")
+                ladder.record_failure("xla")
+                raise _DeviceSolveError(str(e)) from e
+            ladder.record_success("xla")
+            return out
 
         if mesh is not None:
             from kube_batch_tpu.parallel import ShardedSolver
@@ -381,16 +476,13 @@ class XlaAllocateAction(Action):
                                 "single-chip XLA kernel"
                             )
                             sharded = None
-                    return solve_allocate_state(
-                        arrays, st, enable_drf=enable_drf,
-                        enable_proportion=enable_proportion,
-                    )
+                    return _xla_solve(st)
 
                 return solve_sharded
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None
-        if mode != "0" and dtype == np.float32:
+        if mode != "0" and dtype == np.float32 and ladder.allow("pallas"):
             import jax as _jax
 
             from kube_batch_tpu.ops import pallas_solve
@@ -405,6 +497,7 @@ class XlaAllocateAction(Action):
                     log.debug("solving with fused pallas kernel")
                 except Exception:
                     log.exception("pallas solver init failed; using XLA kernel")
+                    ladder.record_failure("pallas")
                     solver = None
 
         def solve_fn(st):
@@ -415,13 +508,16 @@ class XlaAllocateAction(Action):
             nonlocal solver
             if solver is not None:
                 try:
-                    return solver.solve(st)
+                    if faults.should_fire("solve.pallas"):
+                        raise faults.FaultInjected("solve.pallas")
+                    out = solver.solve(st)
+                    ladder.record_success("pallas")
+                    return out
                 except Exception:
                     log.exception("pallas solve failed; falling back to XLA kernel")
+                    ladder.record_failure("pallas")
                     solver = None
-            return solve_allocate_state(
-                arrays, st, enable_drf=enable_drf, enable_proportion=enable_proportion
-            )
+            return _xla_solve(st)
 
         return solve_fn
 
@@ -548,6 +644,10 @@ class _Replayer:
         self.ssn = ssn
         self.enc = enc
         self.arrays = arrays
+        # Native extension boundary: the 'native.load' fault point
+        # simulates the extension failing to load for this cycle — every
+        # native fast path below degrades to its Python twin at once.
+        self._native = None if faults.should_fire("native.load") else _native
         self.task_res64 = np.asarray(arrays["task_res"], np.float64)
         self.task_job = np.asarray(arrays["task_job"])
         self.task_res_has_sc = np.asarray(arrays["task_res_has_sc"])
@@ -702,7 +802,9 @@ class _Replayer:
         # scalar dimensions keep the Go nil-map semantics on the Python
         # side and only run for the (rare) pools whose key sets are
         # non-empty.
-        axpy_native = getattr(_native, "bulk_res_axpy", None) if _native else None
+        axpy_native = (
+            getattr(self._native, "bulk_res_axpy", None) if self._native else None
+        )
 
         def axpy(objs, mat, sign) -> None:
             # Per-POOL fallback: the native prepass guarantees failures
@@ -798,11 +900,13 @@ class _Replayer:
         rows_a = np.ascontiguousarray(rows[order], np.int64)
         nrows_a = np.ascontiguousarray(nrows[order], np.int64)
         segments = None
-        if _native is not None:
+        if self._native is not None:
             try:
+                if faults.should_fire("native.prepass"):
+                    raise ValueError("fault injected: native.prepass")
                 # index vectors go down as int64 buffers — no 2x200k
                 # PyLong boxing/unboxing round trip
-                segments = _native.bulk_assign(
+                segments = self._native.bulk_assign(
                     self.enc.tasks,
                     self.task_keys,
                     self.node_tasks_by_row,
@@ -1021,14 +1125,18 @@ class _Replayer:
         pure_bulk: list = []  # pure-bulk gangs' tasks: ONE status flip below
         ready_cnt_l = ready_cnt.tolist()  # one C pass, not 2 np getitems/job
         job_min_l = np.asarray(job_min).tolist()
-        import logging as _logging
-
-        debug_on = log.isEnabledFor(_logging.DEBUG)  # 2 calls/job otherwise
+        # Gate per-gang debug narration on the PACKAGE verbosity, not on
+        # isEnabledFor: kube_batch_tpu.log._ensure_handler sets the parent
+        # logger to DEBUG the first time ANY glog line is emitted (leader
+        # election chatter, any errorf), which this module logger inherits
+        # — isEnabledFor would then disable the native bulk_dispatch fast
+        # path for the process lifetime at -v 0 (ADVICE r5, medium).
+        debug_on = _glog.get_verbosity() >= 4
         if (
             not self.stepped_jobs
             and not debug_on
-            and _native is not None
-            and hasattr(_native, "bulk_dispatch")
+            and self._native is not None
+            and hasattr(self._native, "bulk_dispatch")
         ):
             # Every gang is pure-bulk (no volumes, no host steps): the
             # whole dispatch barrier is one native pass — per GANG the
@@ -1042,7 +1150,9 @@ class _Replayer:
                 for i, job in enumerate(self.enc.jobs)
             )
             try:
-                to_bind = _native.bulk_dispatch(
+                if faults.should_fire("native.dispatch"):
+                    raise TypeError("fault injected: native.dispatch")
+                to_bind = self._native.bulk_dispatch(
                     self.enc.jobs, mask, TaskStatus.ALLOCATED, BINDING
                 )
                 pure_bulk = to_bind
@@ -1064,9 +1174,9 @@ class _Replayer:
         # identical value is a no-op.
         rows_b = created = keys = hostnames = None
         if to_bind:
-            if _native is not None and hasattr(_native, "finish_columns"):
+            if self._native is not None and hasattr(self._native, "finish_columns"):
                 try:
-                    rb, cb, keys, hostnames = _native.finish_columns(
+                    rb, cb, keys, hostnames = self._native.finish_columns(
                         to_bind, self.row_of, self.task_keys, BINDING
                     )
                     rows_b = np.frombuffer(rb, np.int64)
@@ -1077,9 +1187,9 @@ class _Replayer:
                 # flip the pure-bulk gangs (a partial native prefix flip
                 # is harmless: same value re-set)
                 flipped = False
-                if pure_bulk and _native is not None:
+                if pure_bulk and self._native is not None:
                     try:
-                        _native.bulk_set_slot(pure_bulk, "status", BINDING)
+                        self._native.bulk_set_slot(pure_bulk, "status", BINDING)
                         flipped = True
                     except (TypeError, AttributeError):
                         pass

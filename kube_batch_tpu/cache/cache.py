@@ -29,11 +29,14 @@ round-trips through the watch stream in the reference.
 from __future__ import annotations
 
 import dataclasses
+import os
+import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from kube_batch_tpu import log
+from kube_batch_tpu import faults, log, metrics
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, job_key, pod_key
 from kube_batch_tpu.api.node_info import NodeInfo
@@ -432,6 +435,16 @@ class SchedulerCache:
 
         self._err_tasks = RateLimitingQueue(key_fn=lambda t: t.uid)
         self._deleted_jobs = RateLimitingQueue(key_fn=lambda j: j.uid)
+        # Transient write-side failures retry in place (with jitter)
+        # before the heavier errTasks resync path; see _write_with_retry.
+        try:
+            self._write_retries = max(0, int(os.environ.get("KBT_WRITE_RETRIES", "2")))
+        except ValueError:
+            log.errorf(
+                "KBT_WRITE_RETRIES=%r is not an integer; using 2",
+                os.environ.get("KBT_WRITE_RETRIES"),
+            )
+            self._write_retries = 2
         self._writer: Optional[ThreadPoolExecutor] = None
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -872,9 +885,45 @@ class SchedulerCache:
         for pod, hostname, task in resolved:
             self._do_bind(pod, hostname, task)
 
+    def _write_with_retry(self, op: str, what: str, fn) -> None:
+        """Bounded in-place retry with exponential backoff + jitter for
+        transient write-side failures, before the errTasks resync path
+        takes over. The reference fires a goroutine per bind and routes
+        any failure straight to resync (cache.go:439-448) — a full
+        re-sync plus a whole scheduling cycle of latency for what is
+        usually a blip; retrying the write first keeps the bind landing
+        in this cycle (degradation-ladder rung 1), with resync as the
+        unchanged rung 2. Fault points ``{bind,evict}.write`` (rejected
+        write) and ``bind.slow`` (stalled binder) inject per attempt."""
+        delay = 0.02
+        attempt = 0
+        while True:
+            try:
+                if op == "bind" and faults.should_fire("bind.slow"):
+                    time.sleep(0.05)
+                if faults.should_fire(f"{op}.write"):
+                    raise faults.FaultInjected(f"{op}.write")
+                fn()
+                return
+            except Exception as e:
+                attempt += 1
+                if attempt > self._write_retries:
+                    raise
+                metrics.register_write_retry(op)
+                log.warningf(
+                    "%s of %s failed (attempt %d/%d), retrying: %s",
+                    op, what, attempt, self._write_retries + 1, e,
+                )
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, 0.5)
+
     def _do_bind(self, pod: Pod, hostname: str, task: TaskInfo) -> None:
         try:
-            self.binder.bind(pod, hostname)
+            self._write_with_retry(
+                "bind",
+                f"<{pod.namespace}/{pod.name}>",
+                lambda: self.binder.bind(pod, hostname),
+            )
         except Exception as e:  # noqa: BLE001 - any write failure resyncs
             log.errorf("Failed to bind pod <%s/%s>: %s", pod.namespace, pod.name, e)
             self.resync_task(task)
@@ -893,7 +942,11 @@ class SchedulerCache:
 
     def _do_evict(self, pod: Pod, task: TaskInfo) -> None:
         try:
-            self.evictor.evict(pod)
+            self._write_with_retry(
+                "evict",
+                f"<{pod.namespace}/{pod.name}>",
+                lambda: self.evictor.evict(pod),
+            )
         except Exception as e:  # noqa: BLE001
             log.errorf("Failed to evict pod <%s/%s>: %s", pod.namespace, pod.name, e)
             self.resync_task(task)

@@ -88,11 +88,19 @@ class SchedulerConfiguration:
         actionArguments:
           xla_allocate:
             mesh: auto
+
+    and ``faults``: an optional fault-injection drill spec (the same
+    grammar as the ``KBT_FAULTS`` env var, see kube_batch_tpu.faults) so
+    an operator can arm a failure drill with a conf push — it takes
+    effect on the next cycle via the hot reload, no restart::
+
+        faults: "bind.write:1:2,watch.drop:0.5"
     """
 
     actions: str = ""
     tiers: list[Tier] = field(default_factory=list)
     action_arguments: dict[str, dict[str, str]] = field(default_factory=dict)
+    faults: str = ""
 
 
 # Default conf (reference util.go:31-42).
@@ -121,7 +129,10 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
     """YAML string -> SchedulerConfiguration with plugin defaults applied
     (reference util.go:44-63)."""
     data = yaml.safe_load(conf_str) or {}
-    conf = SchedulerConfiguration(actions=str(data.get("actions", "")))
+    conf = SchedulerConfiguration(
+        actions=str(data.get("actions", "")),
+        faults=str(data.get("faults") or ""),
+    )
     for action_name, args in (data.get("actionArguments") or {}).items():
         conf.action_arguments[str(action_name)] = {
             str(k): str(v) for k, v in (args or {}).items()

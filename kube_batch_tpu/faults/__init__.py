@@ -1,0 +1,240 @@
+"""Fault injection + degradation ladder for the solve/bind/lease pipeline.
+
+Production schedulers of this class treat controlled degradation and
+failure drills as first-class (Kant, arxiv 2510.01256; RLScheduler,
+arxiv 1910.08925); this package gives kube-batch-tpu the same
+discipline:
+
+- a deterministic, env/conf-driven **fault registry** (`registry`):
+  named injection points with probability / count / seed semantics,
+  armed via ``KBT_FAULTS`` or the scheduler conf's ``faults:`` key and
+  checked at the five places failures actually happen — solver entry
+  (actions/xla_allocate), the cache write side (cache/cache), the watch
+  hub and lease elector (server), and the native extension boundary
+  (ops / the bulk replay);
+- a **degradation ladder** (`ladder.DegradationLadder`): a health-scored
+  circuit breaker per solver tier (pallas -> XLA twin -> serial) with
+  exponential-backoff recovery probes, replacing the old one-way
+  exception fallback (a single pallas failure used to demote the tier
+  for the process lifetime with no recovery signal);
+- a **cache-mutation detector** (`mutation_detector.MutationDetector`):
+  the role of the reference's ``KUBE_CACHE_MUTATION_DETECTOR=true`` gate
+  (hack/make-rules/test.sh:27-28), enabled in tier-1 runs via
+  ``KBT_CACHE_MUTATION_DETECTOR``.
+
+Every injected fault and every breaker transition emits a metric
+(metrics.fault_injections / breaker_transitions / breaker_state) and a
+glog line, so a drill is observable end to end on ``/metrics``.
+
+Spec grammar (``KBT_FAULTS`` env var or conf ``faults:`` string)::
+
+    point[:probability[:count[:seed]]][,point2...]
+
+    KBT_FAULTS="bind.write:1:2"          # first two bind writes fail
+    KBT_FAULTS="solve.xla,watch.drop:0.5"  # every xla solve; half of polls
+    KBT_FAULTS="lease.renew:1:3:42"      # 3 renewals fail, RNG seed 42
+
+``probability`` defaults to 1, ``count`` (max fires) to unlimited, and
+``off`` as the probability disarms the point. Probability draws come
+from a per-point RNG seeded from (global seed, point name) — a drill
+replays identically given the same spec and call sequence.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from kube_batch_tpu import log, metrics
+from kube_batch_tpu.faults.ladder import CircuitBreaker, DegradationLadder  # noqa: F401
+
+__all__ = [
+    "POINTS",
+    "FaultInjected",
+    "FaultRegistry",
+    "registry",
+    "should_fire",
+    "CircuitBreaker",
+    "DegradationLadder",
+    "solver_ladder",
+]
+
+
+class FaultInjected(RuntimeError):
+    """Raised by call sites when their injection point fires — typed so
+    chaos tests can tell an injected failure from an organic one."""
+
+
+# The named injection points, one cluster per subsystem where failures
+# actually happen. configure()/arm() reject unknown names so a typo in a
+# drill spec is loud instead of silently never firing.
+POINTS = (
+    # solver entry (actions/xla_allocate.py)
+    "solve.pallas",     # pallas compile/solve raises -> XLA twin
+    "solve.xla",        # XLA twin solve raises -> serial for the cycle
+    "solve.nan",        # NaN poisons a score tensor -> finite guard -> serial
+    # cache write side (cache/cache.py)
+    "bind.write",       # binder write rejected -> retry w/ jitter -> errTasks
+    "bind.slow",        # slow binder (50ms stall per attempt)
+    "evict.write",      # evictor write rejected -> retry -> errTasks
+    # watch hub (server.py)
+    "watch.drop",       # stream drop: poll returns 410-Gone, client re-lists
+    # lease elector (server.py)
+    "lease.renew",      # renewal round-trip fails (arbiter partition/timeout)
+    # native extension boundary (ops/, the bulk replay)
+    "native.load",      # extension unavailable for the cycle -> Python twins
+    "native.prepass",   # bulk_assign prepass raises -> Python replay
+    "native.dispatch",  # bulk_dispatch raises -> Python dispatch barrier
+    "native.class_dedup",  # class_dedup unavailable -> np.unique fallback
+)
+
+
+@dataclass
+class _Rule:
+    point: str
+    probability: float = 1.0
+    count: Optional[int] = None  # max fires; None = unlimited
+    fired: int = 0
+    rng: Optional[random.Random] = None
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed injection points."""
+
+    def __init__(self, spec: Optional[str] = None, seed: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._rules: dict[str, _Rule] = {}
+        self._seed = seed if seed is not None else int(
+            os.environ.get("KBT_FAULTS_SEED", "0") or 0
+        )
+        if spec is None:
+            spec = os.environ.get("KBT_FAULTS", "")
+        if spec:
+            self.configure(spec)
+
+    # -- arming --------------------------------------------------------------
+
+    def _point_rng(self, point: str, seed: Optional[int]) -> random.Random:
+        if seed is None:
+            seed = self._seed ^ zlib.crc32(point.encode())
+        return random.Random(seed)
+
+    def arm(
+        self,
+        point: str,
+        probability: float = 1.0,
+        count: Optional[int] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r} (known: {', '.join(POINTS)})")
+        with self._lock:
+            self._rules[point] = _Rule(
+                point=point,
+                probability=float(probability),
+                count=count,
+                rng=self._point_rng(point, seed),
+            )
+        log.infof(
+            "fault point %s armed (p=%g count=%s)",
+            point, probability, "inf" if count is None else count,
+        )
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(point, None)
+
+    def reset(self) -> None:
+        """Drop every rule (test hygiene between drills)."""
+        self.disarm()
+
+    def configure(self, spec: str) -> None:
+        """Parse and arm a drill spec (see module docstring). Invalid
+        entries are logged and skipped — a bad conf push must not kill
+        the scheduling loop (scheduler.py's conf-reload rule)."""
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            point = parts[0].strip()
+            try:
+                if len(parts) > 1 and parts[1].strip().lower() == "off":
+                    if point not in POINTS:
+                        raise ValueError(f"unknown fault point {point!r}")
+                    self.disarm(point)
+                    continue
+                prob = float(parts[1]) if len(parts) > 1 and parts[1].strip() else 1.0
+                count = int(parts[2]) if len(parts) > 2 and parts[2].strip() else None
+                seed = int(parts[3]) if len(parts) > 3 and parts[3].strip() else None
+                self.arm(point, probability=prob, count=count, seed=seed)
+            except ValueError as e:
+                log.errorf("ignoring invalid fault spec entry %r: %s", entry, e)
+
+    def active(self) -> dict[str, tuple[float, Optional[int], int]]:
+        """point -> (probability, count, fired) for introspection."""
+        with self._lock:
+            return {
+                p: (r.probability, r.count, r.fired) for p, r in self._rules.items()
+            }
+
+    # -- firing --------------------------------------------------------------
+
+    def should_fire(self, point: str) -> bool:
+        """True when the named point is armed and its probability/count
+        say this call fails. A True return is already metered and logged;
+        the call site only has to take its degraded branch (or raise
+        ``FaultInjected`` where an exception is the failure mode)."""
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return False
+            if rule.count is not None and rule.fired >= rule.count:
+                return False
+            if rule.probability < 1.0 and rule.rng.random() >= rule.probability:
+                return False
+            rule.fired += 1
+            fired = rule.fired
+        metrics.register_fault_injection(point)
+        log.warningf("fault injected: %s (fire #%d)", point, fired)
+        return True
+
+
+registry = FaultRegistry()
+
+
+def should_fire(point: str) -> bool:
+    return registry.should_fire(point)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        log.errorf("%s=%r is not an integer; using %d", name, os.environ.get(name), default)
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        log.errorf("%s=%r is not a number; using %g", name, os.environ.get(name), default)
+        return default
+
+
+# The process-wide solver ladder (pallas -> XLA twin -> serial), shared
+# by every xla_allocate execution so breaker state persists across
+# cycles and conf reloads. Tests swap in a short-timeout instance.
+solver_ladder = DegradationLadder(
+    ("pallas", "xla", "serial"),
+    failure_threshold=_env_int("KBT_BREAKER_THRESHOLD", 3),
+    reset_timeout=_env_float("KBT_BREAKER_RESET_S", 30.0),
+)

@@ -1,0 +1,149 @@
+"""Degradation ladder: health-scored circuit breakers per solver tier.
+
+The pre-ladder fallback chain was one-way inside a cycle (pallas solve
+raises -> XLA twin; XLA raises -> the cycle is lost) and carried no
+health state across cycles: a tier that failed once was retried blindly
+every cycle, and a tier demoted by a construction failure gave no signal
+beyond a log line. The ladder replaces that with the standard breaker
+automaton per tier:
+
+- CLOSED: healthy; every cycle may use the tier.
+- OPEN: after ``failure_threshold`` consecutive failures the tier sits
+  out ``reset_timeout`` seconds (the backoff), during which ``allow()``
+  is False and callers route to the next rung down.
+- HALF_OPEN: once the backoff elapses, exactly one probe is allowed
+  through. Probe success -> CLOSED (backoff resets); probe failure ->
+  OPEN again with the backoff doubled (``backoff_factor``), capped at
+  ``max_backoff``.
+
+Every transition emits a metric (breaker_transitions counter +
+breaker_state gauge) and a glog line, so a drill — or a real outage —
+is visible on ``/metrics`` as open -> half_open -> closed history.
+
+The bottom rung of a ``DegradationLadder`` has no breaker: serial is
+the correctness oracle and must always be available.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from kube_batch_tpu import log, metrics
+
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+
+_GAUGE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """One tier's health automaton (see module docstring)."""
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 3,
+        reset_timeout: float = 30.0,
+        backoff_factor: float = 2.0,
+        max_backoff: float = 600.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout = float(reset_timeout)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff = float(max_backoff)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.failures = 0
+        self._backoff = self.reset_timeout
+        self._opened_at = 0.0
+        metrics.set_breaker_state(name, _GAUGE[CLOSED])
+
+    def _transition(self, to: str) -> None:
+        # lock held by caller
+        frm, self.state = self.state, to
+        metrics.register_breaker_transition(self.name, frm, to)
+        metrics.set_breaker_state(self.name, _GAUGE[to])
+        extra = f" (recovery probe in {self._backoff:.1f}s)" if to == OPEN else ""
+        log.warningf("breaker %s: %s -> %s%s", self.name, frm, to, extra)
+
+    def allow(self) -> bool:
+        """May the tier be used right now? An OPEN breaker whose backoff
+        has elapsed transitions to HALF_OPEN and admits the caller as the
+        recovery probe."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if self._clock() - self._opened_at >= self._backoff:
+                    self._transition(HALF_OPEN)
+                    return True
+                return False
+            # HALF_OPEN: a probe is in flight; the solve path is driven
+            # by the single scheduler loop, so admitting the caller is
+            # the probe continuing, not a thundering herd.
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self._backoff = self.reset_timeout
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.state == HALF_OPEN:
+                # failed probe: back off harder before the next one
+                self._backoff = min(self._backoff * self.backoff_factor, self.max_backoff)
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+            elif self.state == CLOSED and self.failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self._backoff = self.reset_timeout
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+
+class DegradationLadder:
+    """Ordered tiers, best first; a breaker per tier except the last
+    (the always-available floor — serial, the correctness oracle)."""
+
+    def __init__(self, tiers=("pallas", "xla", "serial"), **breaker_kw) -> None:
+        self.tiers = tuple(tiers)
+        self.breakers: dict[str, CircuitBreaker] = {
+            t: CircuitBreaker(t, **breaker_kw) for t in self.tiers[:-1]
+        }
+
+    def allow(self, tier: str) -> bool:
+        b = self.breakers.get(tier)
+        return True if b is None else b.allow()
+
+    def record_success(self, tier: str) -> None:
+        b = self.breakers.get(tier)
+        if b is not None:
+            b.record_success()
+
+    def record_failure(self, tier: str) -> None:
+        b = self.breakers.get(tier)
+        if b is not None:
+            b.record_failure()
+
+    def state(self, tier: str) -> str:
+        b = self.breakers.get(tier)
+        return CLOSED if b is None else b.state
+
+    def reset(self) -> None:
+        for b in self.breakers.values():
+            b.reset()

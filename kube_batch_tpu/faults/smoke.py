@@ -1,0 +1,187 @@
+"""Fast chaos smoke: one injected fault per subsystem, each driven
+through a real scheduling path, asserting binds still land and the
+degraded path engaged. Wired into ``hack/verify.py`` (gate 5) so the
+static gate also proves the failure drills work in this image; the full
+chaos suite lives in ``tests/test_faults.py``.
+
+Usage:  python -m kube_batch_tpu.faults.smoke
+Exit 0 iff every drill passes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+
+def _session_binds(expect_timing: str) -> None:
+    """One xla_allocate session over a 12-pod/3-gang cluster; asserts all
+    12 binds land and the action reports the expected path marker."""
+    import kube_batch_tpu.actions.xla_allocate as XA
+    from kube_batch_tpu.conf import parse_scheduler_conf
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.testing import (
+        FakeCache,
+        build_cluster,
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+    pods = [
+        build_pod(
+            name=f"p{i}", group_name=f"g{i % 3}",
+            req=build_resource_list(cpu=1, memory="512Mi"),
+        )
+        for i in range(12)
+    ]
+    nodes = [
+        build_node(f"n{i}", build_resource_list(cpu=8, memory="8Gi", pods=16))
+        for i in range(4)
+    ]
+    cluster = build_cluster(
+        pods, nodes,
+        [build_pod_group(f"g{j}", min_member=4) for j in range(3)],
+        [build_queue("default")],
+    )
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(conf).tiers)
+    action = XA.XlaAllocateAction()
+    action.execute(ssn)
+    close_session(ssn)
+    assert len(cache.binder.binds) == 12, f"only {len(cache.binder.binds)}/12 binds"
+    assert expect_timing in action.last_timings, action.last_timings
+
+
+def drill_solver() -> None:
+    from kube_batch_tpu import faults
+
+    faults.registry.arm("solve.xla", count=1)
+    _session_binds("serial_degraded_s")
+
+
+def drill_native() -> None:
+    from kube_batch_tpu import faults
+
+    faults.registry.arm("native.load")
+    _session_binds("solve_s")
+
+
+def drill_bind() -> None:
+    from kube_batch_tpu import faults
+    from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.testing import build_node, build_pod, build_queue, build_resource_list
+
+    store = ClusterStore()
+    store.create_node(build_node("n0", build_resource_list(cpu=8, memory="8Gi", pods=16)))
+    store.create_queue(build_queue("default"))
+    store.create_pod(build_pod(name="p0", req=build_resource_list(cpu=1, memory="1Gi")))
+    faults.registry.arm("bind.write", count=1)
+    Scheduler(SchedulerCache(store), schedule_period=0.05).run_once()
+    pod = store.get_pod("default", "p0")
+    assert pod is not None and pod.node_name, "bind did not land after retry"
+
+
+def drill_watch() -> None:
+    from kube_batch_tpu import faults
+    from kube_batch_tpu.cache import ClusterStore
+    from kube_batch_tpu.server import WatchHub
+
+    store = ClusterStore()
+    hub = WatchHub(store)
+    faults.registry.arm("watch.drop", count=1)
+    status, events, _rv = hub.poll("queues", 0, 0.1, threading.Event())
+    assert status == "gone", "injected drop did not surface as 410-Gone"
+    status, _, _ = hub.poll("queues", 0, 0.05, threading.Event())
+    assert status == "ok", "poll did not recover after the drop"
+
+
+def drill_lease() -> None:
+    from kube_batch_tpu import faults
+    from kube_batch_tpu.cache import ClusterStore
+    from kube_batch_tpu.server import StoreLeaseElector
+
+    store = ClusterStore()
+    elector = StoreLeaseElector(
+        store, "smoke", "a", lease_duration=30.0,
+        renew_deadline=0.3, retry_period=0.1,
+    )
+    assert elector.acquire(blocking=False)
+    faults.registry.arm("lease.renew")
+    lost = threading.Event()
+    elector.start_renewing(lost.set)
+    assert lost.wait(2.0), "partitioned leader never fired on_lost"
+    faults.registry.reset()
+    # the loss path released: a standby gets the 30s lease immediately
+    lease = store.try_acquire_lease("smoke", "b", 15.0)
+    assert lease.holder_identity == "b", "lease not released on loss"
+
+
+def drill_mutation_detector() -> None:
+    from kube_batch_tpu.cache import ClusterStore
+    from kube_batch_tpu.faults.mutation_detector import CacheMutationError, MutationDetector
+    from kube_batch_tpu.testing import build_node, build_resource_list
+
+    store = ClusterStore()
+    store.create_node(build_node("n0", build_resource_list(cpu=1, memory="1Gi")))
+    det = MutationDetector(store)
+    det.snapshot()
+    store.list("nodes")[0].metadata.labels["mutated"] = "1"
+    try:
+        det.verify()
+    except CacheMutationError:
+        return
+    raise AssertionError("seeded cache mutation was not detected")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("KBT_MIN_DEVICE_PAIRS", "0")
+    from kube_batch_tpu import faults
+
+    drills = (
+        ("solver (solve.xla -> serial degradation)", drill_solver),
+        ("native boundary (native.load -> Python twins)", drill_native),
+        ("cache write (bind.write -> retry w/ jitter)", drill_bind),
+        ("watch hub (watch.drop -> 410-Gone)", drill_watch),
+        ("lease elector (lease.renew -> on_lost + release)", drill_lease),
+        ("cache-mutation detector (seeded violation fires)", drill_mutation_detector),
+    )
+    failed = 0
+    for name, drill in drills:
+        faults.registry.reset()
+        faults.solver_ladder.reset()
+        t0 = time.perf_counter()
+        try:
+            drill()
+        except Exception as e:  # noqa: BLE001 - report every drill
+            failed += 1
+            print(f"chaos smoke: {name}: FAILED ({e})")
+        else:
+            print(f"chaos smoke: {name}: ok ({time.perf_counter() - t0:.2f}s)")
+        finally:
+            faults.registry.reset()
+            faults.solver_ladder.reset()
+    print("chaos smoke:", "FAILED" if failed else "ok", f"({len(drills)} drills)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

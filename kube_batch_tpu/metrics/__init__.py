@@ -211,6 +211,31 @@ unschedule_job_count = Gauge(
 )
 job_retry_counts = Counter(f"{_SUBSYSTEM}_job_retry_counts", "Number of retry counts for one job")
 
+# -- fault injection + degradation ladder (kube_batch_tpu.faults) ----------
+fault_injections = Counter(
+    f"{_SUBSYSTEM}_fault_injections_total", "Injected faults fired, by point"
+)
+breaker_transitions = Counter(
+    f"{_SUBSYSTEM}_breaker_transitions_total",
+    "Degradation-ladder circuit-breaker transitions, by tier and edge",
+)
+breaker_state = Gauge(
+    f"{_SUBSYSTEM}_breaker_state",
+    "Circuit-breaker state per solver tier (0=closed, 1=half_open, 2=open)",
+)
+degraded_cycles = Counter(
+    f"{_SUBSYSTEM}_degraded_cycles_total",
+    "Scheduling cycles that ran below their preferred solver tier, by reason",
+)
+write_retries = Counter(
+    f"{_SUBSYSTEM}_write_retries_total",
+    "Write-side retries (with jitter) before the errTasks resync path, by op",
+)
+cache_mutation_violations = Counter(
+    f"{_SUBSYSTEM}_cache_mutation_violations_total",
+    "In-place mutations of shared cached cluster objects detected, by kind",
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -251,6 +276,30 @@ def update_unschedule_job_count(count: int) -> None:
 
 def register_job_retries(job_name: str) -> None:
     job_retry_counts.inc({"job_id": job_name})
+
+
+def register_fault_injection(point: str) -> None:
+    fault_injections.inc({"point": point})
+
+
+def register_breaker_transition(tier: str, frm: str, to: str) -> None:
+    breaker_transitions.inc({"tier": tier, "from": frm, "to": to})
+
+
+def set_breaker_state(tier: str, value: float) -> None:
+    breaker_state.set(value, {"tier": tier})
+
+
+def register_degraded_cycle(tier: str, reason: str) -> None:
+    degraded_cycles.inc({"tier": tier, "reason": reason})
+
+
+def register_write_retry(op: str) -> None:
+    write_retries.inc({"op": op})
+
+
+def register_cache_mutation(kind: str) -> None:
+    cache_mutation_violations.inc({"kind": kind})
 
 
 def _render_family(metric) -> list[str]:
@@ -300,6 +349,12 @@ def render_prometheus_text() -> str:
         unschedule_task_count,
         unschedule_job_count,
         job_retry_counts,
+        fault_injections,
+        breaker_transitions,
+        breaker_state,
+        degraded_cycles,
+        write_retries,
+        cache_mutation_violations,
     ]
     lines: list[str] = []
     for metric in families:

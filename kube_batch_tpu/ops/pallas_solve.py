@@ -176,9 +176,14 @@ def _class_inverse(a: dict):
         axis=1,
     )
     key = np.ascontiguousarray(key)
+    from kube_batch_tpu import faults as _faults
     from kube_batch_tpu.native import lib as _native
 
-    if _native is not None and hasattr(_native, "class_dedup"):
+    if (
+        _native is not None
+        and hasattr(_native, "class_dedup")
+        and not _faults.should_fire("native.class_dedup")
+    ):
         # O(T) hash pass, classes in first-occurrence order (~10x the
         # void-sort below at 400k). Any consistent (first, inverse)
         # pairing is equivalent — class order carries no meaning in the

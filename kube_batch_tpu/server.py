@@ -59,7 +59,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kube_batch_tpu import log, metrics, version
+from kube_batch_tpu import faults, log, metrics, version
 from kube_batch_tpu.apis.types import ObjectMeta, Queue, QueueSpec
 from kube_batch_tpu.cache import ClusterStore, SchedulerCache
 from kube_batch_tpu.cache.store import KINDS, AlreadyExists, EventHandler
@@ -212,6 +212,11 @@ class WatchHub:
     ) -> tuple[str, list[dict], int]:
         """("ok" | "gone", events, resourceVersion). Blocks up to
         `timeout` seconds for the first event past `since`."""
+        if faults.should_fire("watch.drop"):
+            # Injected stream drop: the 410-Gone contract — the client
+            # must re-list and resume from the returned resourceVersion.
+            with self._cond:
+                return "gone", [], self._seq
         deadline = time.monotonic() + timeout
         while True:
             with self._cond:
@@ -329,6 +334,8 @@ class StoreLeaseElector:
         ``timeout`` bounds the HTTP round-trip — the renewal loop shrinks
         it to its remaining deadline budget so a hanging arbiter cannot
         push loss-detection past the lease expiry."""
+        if faults.should_fire("lease.renew"):
+            raise faults.FaultInjected("lease.renew: injected arbiter partition")
         if isinstance(self.arbiter, str):
             return bool(
                 self._post(
@@ -345,10 +352,10 @@ class StoreLeaseElector:
         )
         return lease.holder_identity == self.identity
 
-    def _release(self) -> None:
+    def _release(self, timeout: float = 5.0) -> None:
         try:
             if isinstance(self.arbiter, str):
-                self._post("release", {"identity": self.identity}, 5.0)
+                self._post("release", {"identity": self.identity}, timeout)
             else:
                 self.arbiter.release_lease(self.lease_name, self.identity)
         except Exception as e:  # best-effort: expiry will hand over anyway
@@ -448,7 +455,18 @@ class StoreLeaseElector:
 
     def _lose(self, why: str, on_lost) -> None:
         log.errorf("lease %s: %s", self.lease_name, why)
+        was_leader = self.is_leader
         self.is_leader = False
+        if was_leader:
+            # Best-effort release BEFORE on_lost (ADVICE r5): a renewal
+            # already in flight when the watchdog fired can still land at
+            # the arbiter (urllib's timeout is per-socket-op), silently
+            # re-extending a dead leader's lease by a full window while
+            # the standby waits it out. Clearing the holder bounds that
+            # window to the in-flight attempt. Short timeout: on_lost is
+            # typically a fatal exit, and renew_deadline + this bound must
+            # stay under lease_duration (15/10/5 reference ratios hold).
+            self._release(timeout=min(2.0, self.retry_period))
         on_lost()
 
     def release(self) -> None:

@@ -29,6 +29,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # the serial action in production.
 os.environ.setdefault("KBT_MIN_DEVICE_PAIRS", "0")
 
+# Cache-mutation detector on for every tier-1 run (VERDICT row 58): the
+# reference gates its whole unit suite on KUBE_CACHE_MUTATION_DETECTOR=true
+# (hack/make-rules/test.sh:27-28); any test driving Scheduler.run_once
+# gets the digest-before/verify-after guard over shared store objects.
+os.environ.setdefault("KBT_CACHE_MUTATION_DETECTOR", "1")
+
 # Persistent compile cache stays inside the repo (gitignored), not the
 # developer's $HOME: warm across local runs, easy to wipe, no pollution.
 os.environ.setdefault(

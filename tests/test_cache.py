@@ -390,7 +390,10 @@ def test_node_condition_change_reaches_mirror(store, cache):
 def test_cache_stop_then_run_resyncs_again(store):
     """stop() then run() must leave the resync machinery live (the
     retry queues reopen)."""
-    binder = FailingBinder(store, fail_times=1)
+    # past the in-place retry budget (KBT_WRITE_RETRIES, default 2), so
+    # the failure reaches the errTasks resync machinery under test —
+    # fewer failures would now be absorbed by the retry-with-jitter rung
+    binder = FailingBinder(store, fail_times=3)
     sc = SchedulerCache(store, binder=binder)
     sc.run()
     sc.stop()

@@ -342,3 +342,32 @@ def test_ha_pair_failover_end_to_end(arbiter):
     assert waited < lease_duration + 1.0, f"failover took {waited:.2f}s"
     el_b.release()
     srv_b.stop()
+
+
+def test_lost_leader_releases_lease_before_on_lost(arbiter):
+    """ADVICE r5 (low): a renewal already in flight when the watchdog
+    fires can land at the arbiter after this process decided it lost,
+    extending a dead leader's lease by a full window. _lose now
+    best-effort releases the lease BEFORE on_lost, so the standby takes
+    over immediately instead of waiting out the (here: long) lease."""
+    a = StoreLeaseElector(
+        _url(arbiter), "kb-race", "a", lease_duration=30.0,
+        renew_deadline=0.3, retry_period=0.1,
+    )
+    assert a.acquire(blocking=False)
+    lost = threading.Event()
+
+    def broken(timeout=5.0):
+        raise OSError("injected renewal failure")
+
+    a._try_acquire = broken  # renewals fail; the release POST still works
+    a.start_renewing(lost.set)
+    assert lost.wait(2.0), "leader never noticed the renewal failures"
+    assert not a.is_leader
+    # with a 30s lease, only an explicit release lets b in immediately
+    b = StoreLeaseElector(
+        _url(arbiter), "kb-race", "b", lease_duration=5.0,
+        renew_deadline=4.0, retry_period=0.1,
+    )
+    assert b.acquire(blocking=False), "lease was not released on loss"
+    b.release()

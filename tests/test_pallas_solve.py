@@ -341,3 +341,87 @@ def test_pod_affinity_keeps_pallas_kernel(monkeypatch):
     assert len(serial_binds) == 8
     # initial segment + a resume per host-stepped affinity pod
     assert solve_calls["n"] >= 3, f"pallas did not drive the hybrid ({solve_calls})"
+
+
+class TestClassDedupParity:
+    """ADVICE r5 (low): the native class_dedup numbers classes in
+    first-occurrence order, the np.unique fallback in sorted-key order.
+    Class id order is documented as meaningless — these tests pin that
+    the two paths produce the SAME task partition and the SAME binds, so
+    a future consumer tie-breaking on class id cannot diverge undetected
+    between KBT_NATIVE=0 and native runs."""
+
+    def _arrays(self):
+        """A snapshot with real class structure: duplicate pods (one
+        class), a distinct-resource pod, and port/gang variation."""
+        cache = FakeCache(synthetic(96, 8, tasks_per_job=6))
+        ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+        enc = encode_session(
+            ssn.jobs, ssn.nodes, ssn.queues, dtype=np.float32,
+            drf=ssn.plugins.get("drf"), proportion=ssn.plugins.get("proportion"),
+        )
+        close_session(ssn)
+        return dict(enc.arrays)
+
+    def test_partition_and_reconstruction_parity(self):
+        from kube_batch_tpu import faults
+        from kube_batch_tpu.native import lib as native_lib
+        from kube_batch_tpu.ops import pallas_solve as PS
+
+        if native_lib is None or not hasattr(native_lib, "class_dedup"):
+            pytest.skip("native class_dedup unavailable in this image")
+        a = self._arrays()
+
+        PS._class_inv_slot = None  # drop the per-cycle memo
+        tports_n, first_n, inv_n = PS._class_inverse(a)
+
+        faults.registry.arm("native.class_dedup")  # force the fallback
+        try:
+            PS._class_inv_slot = None
+            tports_f, first_f, inv_f = PS._class_inverse(a)
+        finally:
+            faults.registry.reset()
+            PS._class_inv_slot = None
+
+        assert np.array_equal(tports_n, tports_f)
+        assert first_n.shape == first_f.shape  # same class count
+        # each representative index reconstructs its own class id
+        assert np.array_equal(inv_n[first_n], np.arange(first_n.shape[0]))
+        assert np.array_equal(inv_f[first_f], np.arange(first_f.shape[0]))
+
+        # the task partition (which tasks share a class) is identical,
+        # independent of class numbering
+        def partition(inv):
+            groups: dict[int, list[int]] = {}
+            for task_row, cls in enumerate(inv.tolist()):
+                groups.setdefault(cls, []).append(task_row)
+            return sorted(tuple(g) for g in groups.values())
+
+        assert partition(inv_n) == partition(inv_f)
+
+    def test_binds_identical_native_vs_fallback(self, monkeypatch):
+        """Same snapshot through the full action (interpret-mode pallas,
+        which consumes the class tables) with and without the native
+        dedup: identical binds."""
+        from kube_batch_tpu import faults
+        from kube_batch_tpu.actions.xla_allocate import XlaAllocateAction
+        from kube_batch_tpu.ops import pallas_solve as PS
+
+        monkeypatch.setenv("KBT_PALLAS", "interpret")
+
+        def run():
+            PS._class_inv_slot = None
+            cache = FakeCache(synthetic(80, 8))
+            ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+            XlaAllocateAction(dtype=np.float32).execute(ssn)
+            close_session(ssn)
+            return dict(cache.binder.binds)
+
+        native_binds = run()
+        faults.registry.arm("native.class_dedup")
+        try:
+            fallback_binds = run()
+        finally:
+            faults.registry.reset()
+            PS._class_inv_slot = None
+        assert native_binds == fallback_binds != {}

@@ -644,3 +644,57 @@ def test_task_latency_histogram_stamped_from_solve_completion():
     # each stamp ~5s (creation 5s ago, decided moments later) — a wrong
     # timestamp source (0, or absolute wall time) falls outside the band
     assert 4.0 <= d_sum / 6 <= 60.0, d_sum / 6
+
+
+def test_native_dispatch_engages_after_glog_line(monkeypatch):
+    """ADVICE r5 (medium): emitting ONE glog line initializes the package
+    handler, which sets the parent 'kube_batch_tpu' logger to DEBUG; the
+    old `log.isEnabledFor(DEBUG)` gate then read True forever at -v 0 and
+    permanently disabled the native bulk_dispatch fast path. The gate is
+    package verbosity now — the native path must engage regardless of
+    handler initialization."""
+    import kube_batch_tpu.actions.xla_allocate as XA
+    from kube_batch_tpu import log as glog
+
+    # the handler-initializing line (leader-election startup chatter,
+    # any errorf — one is enough)
+    glog.infof("startup chatter: handler now initialized")
+    assert glog.get_verbosity() < 4
+
+    calls = {"dispatch": 0}
+
+    class FakeNative:
+        """bulk_dispatch with the real semantics (gang buckets move
+        wholesale ALLOCATED -> BINDING, tasks in dispatch order); every
+        other native entry point absent, so the replay's remaining steps
+        take their Python twins."""
+
+        def bulk_dispatch(self, jobs, mask, allocated_status, binding_status):
+            calls["dispatch"] += 1
+            out = []
+            for i, job in enumerate(jobs):
+                if not mask[i]:
+                    continue
+                allocated = job.task_status_index.pop(allocated_status, None)
+                if not allocated:
+                    continue
+                for t in allocated.values():
+                    t.status = binding_status
+                binding = job.task_status_index.setdefault(binding_status, {})
+                binding.update(allocated)
+                out.extend(allocated.values())
+            return out
+
+    monkeypatch.setattr(XA, "_native", FakeNative())
+    pods = [
+        build_pod(name=f"p{i}", group_name="g", req=build_resource_list(cpu=1, memory="512Mi"))
+        for i in range(4)
+    ]
+    nodes = [build_node(f"n{i}", build_resource_list(cpu=4, memory="4Gi", pods=10)) for i in range(2)]
+    cluster = build_cluster(pods, nodes, [build_pod_group("g", min_member=4)], [build_queue("default")])
+    cache = FakeCache(cluster)
+    ssn = open_session(cache, parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers)
+    XA.XlaAllocateAction().execute(ssn)
+    close_session(ssn)
+    assert len(cache.binder.binds) == 4
+    assert calls["dispatch"] == 1, "native bulk_dispatch fast path did not engage"
