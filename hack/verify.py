@@ -12,13 +12,19 @@ Runs, in order:
    `== None` / `!= None` comparisons (E711), mutable default arguments
    (B006), and f-strings without placeholders (F541);
 4. ruff + mypy when importable (CI images that carry them get the full
-   gate; their absence here degrades to the stdlib checks, loudly);
+   gate; their absence degrades to the stdlib checks, loudly — unless
+   ``--strict``, which makes a missing tool a FAILURE, so an image
+   rebuild that silently drops ruff/mypy cannot turn the gate green);
 5. the chaos smoke (kube_batch_tpu.faults.smoke): one injected fault per
    subsystem — solver, native boundary, cache write, watch hub, lease
    elector — plus a seeded cache-mutation-detector violation, each
    through a real scheduling path, asserting binds still land.
 
-Exit 0 iff every gate is clean. Usage:  python hack/verify.py
+Exit 0 iff every gate is clean. Usage:  python hack/verify.py [--strict]
+
+CI/the deployment image run ``--strict`` (the Dockerfile installs ruff +
+mypy via the ``dev`` extra); the bare container, which cannot install
+packages, runs the default lenient mode.
 """
 
 from __future__ import annotations
@@ -200,7 +206,13 @@ def run_optional(tool: str, args: list[str]) -> int | None:
     return res.returncode
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    strict = "--strict" in argv
+    unknown = [a for a in argv if a not in ("--strict",)]
+    if unknown:
+        print(f"verify: unknown argument(s): {' '.join(unknown)}")
+        return 2
     files = py_files()
     failed = False
 
@@ -243,8 +255,13 @@ def main() -> int:
     ):
         rc = run_optional(tool, args)
         if rc is None:
-            print(f"verify: {tool} unavailable in this image — skipped "
-                  "(stdlib gates above still ran)")
+            if strict:
+                print(f"verify: {tool} unavailable — FAILED (--strict: "
+                      "install the 'dev' extra: pip install -e '.[dev]')")
+                failed = True
+            else:
+                print(f"verify: {tool} unavailable in this image — skipped "
+                      "(stdlib gates above still ran; --strict to require)")
         elif rc != 0:
             print(f"verify: {tool} FAILED")
             failed = True

@@ -114,6 +114,10 @@ class XlaAllocateAction(Action):
         # Devices in the mesh the last execute() resolved (1 = single-chip);
         # the driver dryrun asserts on this to prove the sharded path ran.
         self.last_mesh_size = 1
+        # Which rung actually solved the last execute() ("mesh_pallas",
+        # "sharded_xla", "pallas", "xla", "serial"); bench rows assert on
+        # this so a silent downgrade cannot masquerade as evidence.
+        self.last_solver_tier = "none"
 
     @property
     def name(self) -> str:
@@ -126,6 +130,7 @@ class XlaAllocateAction(Action):
         from kube_batch_tpu.ops.kernels import result_of, solve_allocate_state
 
         self.last_timings = {}  # never report a previous cycle's path
+        self.last_solver_tier = "none"
         if not _kernel_supported(ssn):
             log.info("conf outside kernel envelope; running serial allocate")
             self._fallback(ssn)
@@ -440,44 +445,116 @@ class XlaAllocateAction(Action):
                 ladder.record_failure("xla")
                 raise _DeviceSolveError(str(e)) from e
             ladder.record_success("xla")
+            self.last_solver_tier = "xla"
             return out
 
         if mesh is not None:
+            from kube_batch_tpu.ops import pallas_solve
             from kube_batch_tpu.parallel import ShardedSolver
 
-            solver = None
+            xla_sharded = None
             try:
-                solver = ShardedSolver(
+                xla_sharded = ShardedSolver(
                     arrays, mesh, enable_drf=enable_drf,
                     enable_proportion=enable_proportion,
-                )
-                log.info(
-                    "solving with node-axis-sharded XLA kernel over a "
-                    "%d-device mesh", mesh.devices.size,
                 )
             except Exception:
                 log.exception(
                     "sharded solver init failed; using single-chip path"
                 )
-            if solver is not None:
-                sharded = solver
 
-                def solve_sharded(st):
-                    # First solve still traces/compiles lazily; fall back
-                    # to the single-chip XLA kernel on failure rather
-                    # than losing the cycle.
-                    nonlocal sharded
-                    if sharded is not None:
+            def solve_sharded(st):
+                # The mesh's XLA rung. First solve still traces/compiles
+                # lazily; fall back to the single-chip XLA kernel on
+                # failure rather than losing the cycle.
+                nonlocal xla_sharded
+                if xla_sharded is not None:
+                    try:
+                        out = xla_sharded.solve(st)
+                        self.last_solver_tier = "sharded_xla"
+                        return out
+                    except Exception:
+                        log.exception(
+                            "sharded solve failed; falling back to "
+                            "single-chip XLA kernel"
+                        )
+                        xla_sharded = None
+                return _xla_solve(st)
+
+            # Top rung of the mesh path: the blocked sharded-Pallas
+            # solver (parallel.sharded_pallas) — the fused block kernel
+            # per shard, one argmax exchange per gang iteration. The
+            # VMEM gate is PER SHARD (pallas_solve.mesh_supported): a
+            # snapshot that overflows one chip's vmem_budget() stays on
+            # the Pallas rung when its node block divided over the mesh
+            # fits, instead of falling to the ~9x-slower XLA twin.
+            # KBT_MESH_PALLAS=0/off disables the rung; mosaic/interpret/
+            # jnp pin the block backend (default auto: mosaic on TPU
+            # meshes, the jnp twin elsewhere).
+            mesh_pallas = None
+            mmode = (
+                os.environ.get("KBT_MESH_PALLAS", "auto").strip().lower()
+                or "auto"
+            )
+            if (
+                mmode not in ("0", "off")
+                and dtype == np.float32
+                and ladder.allow("mesh_pallas")
+                and pallas_solve.mesh_supported(arrays, mesh.devices.size)
+            ):
+                from kube_batch_tpu.parallel.sharded_pallas import (
+                    ShardedPallasSolver,
+                )
+
+                try:
+                    mesh_pallas = ShardedPallasSolver(
+                        arrays, mesh, enable_drf=enable_drf,
+                        enable_proportion=enable_proportion,
+                        block_impl=mmode,
+                    )
+                    log.info(
+                        "solving with blocked sharded-Pallas kernel "
+                        "(%s block) over a %d-device mesh",
+                        mesh_pallas.block_impl, mesh.devices.size,
+                    )
+                except Exception:
+                    log.exception(
+                        "sharded-Pallas solver init failed; using the "
+                        "mesh XLA rung"
+                    )
+                    ladder.record_failure("mesh_pallas")
+
+            if mesh_pallas is not None:
+                mp = mesh_pallas
+
+                def solve_mesh_pallas(st):
+                    # Tracing/compile is lazy here too; a failed solve
+                    # feeds the mesh_pallas breaker and degrades to the
+                    # mesh XLA rung within the cycle.
+                    nonlocal mp
+                    if mp is not None:
                         try:
-                            return sharded.solve(st)
+                            if faults.should_fire("solve.mesh_pallas"):
+                                raise faults.FaultInjected("solve.mesh_pallas")
+                            out = mp.solve(st)
+                            ladder.record_success("mesh_pallas")
+                            self.last_solver_tier = "mesh_pallas"
+                            return out
                         except Exception:
                             log.exception(
-                                "sharded solve failed; falling back to "
-                                "single-chip XLA kernel"
+                                "sharded-Pallas solve failed; falling "
+                                "back to the mesh XLA rung"
                             )
-                            sharded = None
-                    return _xla_solve(st)
+                            ladder.record_failure("mesh_pallas")
+                            mp = None
+                    return solve_sharded(st)
 
+                return solve_mesh_pallas
+            if xla_sharded is not None:
+                log.info(
+                    "solving with node-axis-sharded XLA kernel over a "
+                    "%d-device mesh", mesh.devices.size,
+                )
                 return solve_sharded
 
         mode = os.environ.get("KBT_PALLAS", "1")
@@ -512,6 +589,7 @@ class XlaAllocateAction(Action):
                         raise faults.FaultInjected("solve.pallas")
                     out = solver.solve(st)
                     ladder.record_success("pallas")
+                    self.last_solver_tier = "pallas"
                     return out
                 except Exception:
                     log.exception("pallas solve failed; falling back to XLA kernel")
@@ -614,10 +692,10 @@ class XlaAllocateAction(Action):
                 cur = -1
         return s._replace(cur=np.int32(cur), it=s.it + np.int32(1))
 
-    @staticmethod
-    def _fallback(ssn: Session) -> None:
+    def _fallback(self, ssn: Session) -> None:
         from kube_batch_tpu.actions.allocate import AllocateAction
 
+        self.last_solver_tier = "serial"
         AllocateAction().execute(ssn)
 
 

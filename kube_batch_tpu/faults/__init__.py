@@ -13,7 +13,8 @@ discipline:
   hub and lease elector (server), and the native extension boundary
   (ops / the bulk replay);
 - a **degradation ladder** (`ladder.DegradationLadder`): a health-scored
-  circuit breaker per solver tier (pallas -> XLA twin -> serial) with
+  circuit breaker per solver tier (blocked sharded-Pallas -> pallas ->
+  XLA twin -> serial) with
   exponential-backoff recovery probes, replacing the old one-way
   exception fallback (a single pallas failure used to demote the tier
   for the process lifetime with no recovery signal);
@@ -74,6 +75,7 @@ class FaultInjected(RuntimeError):
 # drill spec is loud instead of silently never firing.
 POINTS = (
     # solver entry (actions/xla_allocate.py)
+    "solve.mesh_pallas",  # blocked sharded-Pallas raises -> mesh XLA rung
     "solve.pallas",     # pallas compile/solve raises -> XLA twin
     "solve.xla",        # XLA twin solve raises -> serial for the cycle
     "solve.nan",        # NaN poisons a score tensor -> finite guard -> serial
@@ -230,11 +232,13 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-# The process-wide solver ladder (pallas -> XLA twin -> serial), shared
-# by every xla_allocate execution so breaker state persists across
-# cycles and conf reloads. Tests swap in a short-timeout instance.
+# The process-wide solver ladder (blocked sharded-Pallas -> single-chip
+# pallas -> XLA twin -> serial), shared by every xla_allocate execution
+# so breaker state persists across cycles and conf reloads. mesh_pallas
+# is the top rung when a mesh is resolved; on a single chip the ladder
+# starts at pallas. Tests swap in a short-timeout instance.
 solver_ladder = DegradationLadder(
-    ("pallas", "xla", "serial"),
+    ("mesh_pallas", "pallas", "xla", "serial"),
     failure_threshold=_env_int("KBT_BREAKER_THRESHOLD", 3),
     reset_timeout=_env_float("KBT_BREAKER_RESET_S", 30.0),
 )

@@ -16,6 +16,10 @@ automaton per tier:
   OPEN again with the backoff doubled (``backoff_factor``), capped at
   ``max_backoff``.
 
+The solver ladder runs mesh_pallas (blocked sharded-Pallas, when a mesh
+is resolved) -> pallas (single-chip fused kernel) -> xla (the while-loop
+twin) -> serial.
+
 Every transition emits a metric (breaker_transitions counter +
 breaker_state gauge) and a glog line, so a drill — or a real outage —
 is visible on ``/metrics`` as open -> half_open -> closed history.
@@ -120,7 +124,9 @@ class DegradationLadder:
     """Ordered tiers, best first; a breaker per tier except the last
     (the always-available floor — serial, the correctness oracle)."""
 
-    def __init__(self, tiers=("pallas", "xla", "serial"), **breaker_kw) -> None:
+    def __init__(
+        self, tiers=("mesh_pallas", "pallas", "xla", "serial"), **breaker_kw
+    ) -> None:
         self.tiers = tuple(tiers)
         self.breakers: dict[str, CircuitBreaker] = {
             t: CircuitBreaker(t, **breaker_kw) for t in self.tiers[:-1]

@@ -156,6 +156,63 @@ def _share_rows(alloc, denom, dims):
     return jnp.maximum(jnp.max(s, axis=-1), 0.0)
 
 
+def select_queue_job(
+    a: dict, s: SolveState, enable_drf: bool, enable_proportion: bool
+):
+    """The replicated queue + job selection half of one loop iteration
+    (proportion share asc -> overused gate -> priority/gang/drf keys),
+    shared verbatim by the single-chip XLA twin and the blocked
+    sharded-Pallas driver (parallel/sharded_pallas) so the two paths
+    cannot drift on selection numerics. Only non-node SolveState fields
+    are read, so callers may carry node state in any layout.
+
+    Returns (qsel, q_any, overused, jsel, j_any); qsel/jsel are int32
+    and garbage when the matching `any` is False.
+    """
+    Q = a["queue_rank"].shape[0]
+    job_queue = a["job_queue"]
+    eps = a["eps"]
+    q_has = (
+        jnp.zeros(Q, jnp.int32).at[job_queue].max(s.job_active.astype(jnp.int32))
+        > 0
+    ) & ~s.q_dropped
+    if enable_proportion:
+        q_share = _share_rows(s.q_alloc, a["q_deserved"], a["q_dims"])
+        qsel, q_any = _lex_argmin(q_has, q_share, a["queue_rank"])
+    else:
+        qsel, q_any = _lex_argmin(q_has, a["queue_rank"])
+    qsel = qsel.astype(jnp.int32)
+
+    if enable_proportion:
+        # Overused gate: deserved.LessEqual(allocated) with the Go
+        # nil-scalar-map branch (proportion.go:188-199 +
+        # resource_info.go:255-278).
+        d_row = a["q_deserved"][qsel]
+        a_row = s.q_alloc[qsel]
+        dim_ok = (d_row < a_row) | (jnp.abs(a_row - d_row) < eps)
+        sc_ok = jnp.concatenate(
+            [
+                jnp.ones(2, bool),
+                jnp.full(dim_ok.shape[0] - 2, s.q_alloc_has_sc[qsel]),
+            ]
+        )
+        dim_ok = dim_ok & sc_ok
+        overused = jnp.all(jnp.where(a["q_dims"][qsel], dim_ok, True))
+    else:
+        overused = jnp.bool_(False)
+
+    ready_bit = (s.ready_cnt >= a["job_min"]).astype(jnp.int32)
+    jmask = s.job_active & (job_queue == qsel)
+    jkeys = [-a["job_prio"], ready_bit]
+    if enable_drf:
+        jkeys.append(
+            _share_rows(s.job_alloc, a["drf_total"][None, :], a["drf_dims"][None, :])
+        )
+    jkeys.append(a["job_rank"])
+    jsel, j_any = _lex_argmin(jmask, *jkeys)
+    return qsel, q_any, overused, jsel.astype(jnp.int32), j_any
+
+
 @partial(jax.jit, static_argnames=("enable_drf", "enable_proportion"))
 def init_state(a: dict, enable_drf: bool = False, enable_proportion: bool = False) -> SolveState:
     """Fresh solve state from an encoded snapshot (see ops.encode)."""
@@ -222,23 +279,13 @@ def solve_allocate_step(
     pod_sc = a["pod_sc"]  # [GT, N] InterPodAffinity (zeros when inactive)
     job_end = a["job_end"]
     job_min = a["job_min"]
-    job_prio = a["job_prio"]
-    job_rank = a["job_rank"]
     job_queue = a["job_queue"]
-    queue_rank = a["queue_rank"]
     eps = a["eps"]
     fdtype = task_req.dtype
     w_least = jnp.asarray(a["w_least"], fdtype)
     w_balanced = jnp.asarray(a["w_balanced"], fdtype)
     w_aff = jnp.asarray(a["w_aff"], fdtype)
     w_podaff = jnp.asarray(a["w_podaff"], fdtype)
-    if enable_drf:
-        drf_total = a["drf_total"]
-        drf_dims = a["drf_dims"]
-    if enable_proportion:
-        q_deserved = a["q_deserved"]
-        q_dims = a["q_dims"]
-        eps_row = eps[None, :]
 
     # One iteration per task pop, job drop, queue drop, plus one paused
     # iteration per host-only task in the segmented hybrid.
@@ -258,49 +305,12 @@ def solve_allocate_step(
     def body(s: SolveState) -> SolveState:
         # -- queue + job selection (only bites when no current job) ---------
         need_sel = s.cur < 0
-        q_has = (
-            jnp.zeros(Q, jnp.int32).at[job_queue].max(s.job_active.astype(jnp.int32))
-            > 0
-        ) & ~s.q_dropped
-        if enable_proportion:
-            q_share = _share_rows(s.q_alloc, q_deserved, q_dims)
-            qsel, q_any = _lex_argmin(q_has, q_share, queue_rank)
-        else:
-            qsel, q_any = _lex_argmin(q_has, queue_rank)
-        qsel = qsel.astype(jnp.int32)
-
-        if enable_proportion:
-            # Overused gate: deserved.LessEqual(allocated) with the Go
-            # nil-scalar-map branch (proportion.go:188-199 +
-            # resource_info.go:255-278).
-            d_row = q_deserved[qsel]
-            a_row = s.q_alloc[qsel]
-            dim_ok = (d_row < a_row) | (jnp.abs(a_row - d_row) < eps)
-            sc_ok = jnp.concatenate(
-                [
-                    jnp.ones(2, bool),
-                    jnp.full(dim_ok.shape[0] - 2, s.q_alloc_has_sc[qsel]),
-                ]
-            )
-            dim_ok = dim_ok & sc_ok
-            overused = jnp.all(jnp.where(q_dims[qsel], dim_ok, True))
-        else:
-            overused = jnp.bool_(False)
-
-        drop_q = need_sel & q_any & overused
-
-        ready_bit = (s.ready_cnt >= job_min).astype(jnp.int32)
-        jmask = s.job_active & (job_queue == qsel)
-        jkeys = [-job_prio, ready_bit]
-        if enable_drf:
-            jkeys.append(_share_rows(s.job_alloc, drf_total[None, :], drf_dims[None, :]))
-        jkeys.append(job_rank)
-        jsel, j_any = _lex_argmin(jmask, *jkeys)
-
-        sel_ok = q_any & ~overused & j_any
-        cur = jnp.where(
-            need_sel, jnp.where(sel_ok, jsel.astype(jnp.int32), -1), s.cur
+        qsel, q_any, overused, jsel, j_any = select_queue_job(
+            a, s, enable_drf, enable_proportion
         )
+        drop_q = need_sel & q_any & overused
+        sel_ok = q_any & ~overused & j_any
+        cur = jnp.where(need_sel, jnp.where(sel_ok, jsel, -1), s.cur)
 
         # Dropping an overused queue retires all its jobs for this cycle
         # (the serial heap drains the queue's remaining entries the same

@@ -255,6 +255,44 @@ def supported(a: dict) -> bool:
     return vmem <= vmem_budget()
 
 
+def block_vmem_bytes(a: dict, mesh_size: int) -> int:
+    """Per-shard VMEM the *blocked* sharded solve claims (bytes).
+
+    The blocked path (parallel/sharded_pallas.ShardedPallasSolver) keeps
+    only the node-axis slab resident in the block kernel's VMEM: the
+    fused feasibility+score kernel reads the local node block of the
+    statics (cnode, affw, nalloc, nmax, nihs, nrhs) and the dynamic node
+    state (idle, rel, used, ntasks, nports). Task/job/queue state stays
+    replicated in XLA-land (HBM/registers), so — unlike the single-chip
+    fused kernel, whose envelope is dominated by the task fold at large
+    T — the blocked envelope scales with N / mesh_size only. That is the
+    capacity story: a snapshot that overflows `vmem_budget()` on one
+    chip stays on the Pallas rung when its node block divided over the
+    mesh fits.
+    """
+    N = a["node_idle"].shape[0]
+    GT = a["compat"].shape[0]
+    Nr = _rows(N)
+    n_loc = -(-Nr // max(mesh_size, 1)) * LANES  # folded columns per shard
+    # elements (4 bytes each): cnode+affw [GT,...] statics, nalloc +
+    # idle/rel/used [R8,...], nmax/nihs/nrhs/ntasks/nports flat, plus the
+    # candidate/score scratch the kernel materializes (~4 flat arrays).
+    elems = n_loc * (2 * GT + 4 * R8 + 5 + 4)
+    return elems * 4
+
+
+def mesh_supported(a: dict, mesh_size: int) -> bool:
+    """Envelope check for the blocked sharded-Pallas path: same static
+    limits as the single-chip kernel (resource rank, host ports), but the
+    VMEM gate is per shard — `block_vmem_bytes(a, mesh_size)` against the
+    device budget."""
+    if a["task_req"].shape[1] > R8:
+        return False
+    if a["task_ports"].shape[1] > 31:
+        return False
+    return block_vmem_bytes(a, mesh_size) <= vmem_budget()
+
+
 def fold_affinity_scores(a: dict, Nr: int) -> np.ndarray:
     """[GT, Nr, 128] combined static score term: preferred node-affinity
     plus live InterPodAffinity, each pre-weighted (the kernel multiplies
@@ -846,6 +884,244 @@ def _build(
         return icat, fcat
 
     return jax.jit(wrapped)
+
+
+# -- blocked sharded-Pallas entry (parallel/sharded_pallas) ---------------
+#
+# The block step is the per-shard half of one gang iteration: the fused
+# feasibility + score + block-local argmax over the shard's node block,
+# in the same folded [R8, Nr_loc, 128] VMEM layout and with the same
+# float32 formulas as the single-chip fused kernel above. The caller
+# (ShardedPallasSolver) exchanges the returned (best score, global node
+# index, fits-idle bit) triple across the mesh axis per iteration and
+# applies the winning capacity update on the owning shard only.
+#
+# fvec layout (f32, 32): [0:8] padded task req, [8:16] padded task res,
+# [16:24] padded eps (pad dims carry 1.0 so the all-dims fit check
+# ignores them), [24] w_least, [25] w_balanced (affinity weights are
+# baked into affw at fold time, as in the single-chip kernel).
+# ivec layout (i32, 8): [0] gid (pre-clamped to GT-1), [1] task has_sc,
+# [2] task port bitmask, [3] global folded index offset of this shard's
+# block, [4] the "no candidate" index sentinel (global padded N).
+
+FVEC_LEN = 32
+IVEC_LEN = 8
+
+
+@lru_cache(maxsize=64)
+def _build_block_step(Nr_loc: int, GT: int, interpret: bool):
+    """Compile (cached per local block shape) the fused block step."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    MAX_PRIORITY = 10
+    NINF = float("-inf")
+
+    def kernel(
+        ivec_ref, fvec_ref,
+        cnode_ref, affw_ref, nalloc_ref, nmax_ref, nihs_ref, nrhs_ref,
+        idle_ref, rel_ref, used_ref, ntasks_ref, nports_ref,
+        oscore_ref, oidx_ref,
+    ):
+        gid = ivec_ref[0]
+        has_sc = ivec_ref[1] != 0
+        tports = ivec_ref[2]
+        off = ivec_ref[3]
+        sentinel = ivec_ref[4]
+
+        lane = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        nidx = (
+            lax.broadcasted_iota(jnp.int32, (Nr_loc, LANES), 0) * LANES
+            + lax.broadcasted_iota(jnp.int32, (Nr_loc, LANES), 1)
+        )
+
+        req_v = jnp.concatenate(
+            [jnp.full((1, 1), fvec_ref[i], jnp.float32) for i in range(R8)]
+        )
+        eps_v = jnp.concatenate(
+            [jnp.full((1, 1), fvec_ref[16 + i], jnp.float32) for i in range(R8)]
+        )
+        req3 = req_v[:, :, None]
+        eps3 = eps_v[:, :, None]
+
+        # -- feasibility over the local node block (== single-chip kernel) --
+        fits_idle = jnp.all(req3 < idle_ref[:, :, :] + eps3, axis=0) & ~(
+            has_sc & (nihs_ref[:, :] == 0)
+        )
+        fits_rel = jnp.all(req3 < rel_ref[:, :, :] + eps3, axis=0) & ~(
+            has_sc & (nrhs_ref[:, :] == 0)
+        )
+        static_ok = cnode_ref[pl.ds(gid, 1), :, :][0] != 0
+        room = ntasks_ref[:, :] < nmax_ref[:, :]
+        port_ok = (nports_ref[:, :] & tports) == 0
+        cand = static_ok & room & port_ok & (fits_idle | fits_rel)
+
+        # -- score + deterministic block-local best ------------------------
+        req_cpu = used_ref[0, :, :] + fvec_ref[8]
+        req_mem = used_ref[1, :, :] + fvec_ref[9]
+        cap_cpu = nalloc_ref[0, :, :]
+        cap_mem = nalloc_ref[1, :, :]
+
+        def least_dim(rq, cp):
+            safe = jnp.where(cp == 0.0, 1.0, cp)
+            sc = jnp.floor(
+                _ieee_div((cp - rq) * MAX_PRIORITY, safe)
+            ).astype(jnp.int32)
+            return jnp.where((cp == 0.0) | (rq > cp), 0, sc)
+
+        least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
+        cpu_f = jnp.where(
+            cap_cpu != 0.0,
+            _ieee_div(req_cpu, jnp.where(cap_cpu == 0.0, 1.0, cap_cpu)),
+            1.0,
+        )
+        mem_f = jnp.where(
+            cap_mem != 0.0,
+            _ieee_div(req_mem, jnp.where(cap_mem == 0.0, 1.0, cap_mem)),
+            1.0,
+        )
+        balanced = jnp.where(
+            (cpu_f >= 1.0) | (mem_f >= 1.0),
+            0,
+            (MAX_PRIORITY - jnp.abs(cpu_f - mem_f) * MAX_PRIORITY).astype(
+                jnp.int32
+            ),
+        )
+        score = (
+            least.astype(jnp.float32) * fvec_ref[24]
+            + balanced.astype(jnp.float32) * fvec_ref[25]
+            + affw_ref[pl.ds(gid, 1), :, :][0]
+        )
+        big = jnp.max(jnp.where(cand, score, NINF))
+        any_c = big > NINF
+        nb = jnp.min(jnp.where(cand & (score == big), nidx, INT_MAX))
+        nb = jnp.minimum(nb, Nr_loc * LANES - 1)
+
+        # fits-idle at the block-local best (scalar recompute per dim —
+        # Mosaic cannot do i1 vector extraction at [8,1], same idiom as
+        # the single-chip kernel's extdim unroll)
+        def exti(ref, idx):
+            r, l = idx // LANES, idx % LANES
+            return jnp.sum(
+                jnp.where(lane == l, ref[pl.ds(r, 1), :], 0), dtype=jnp.int32
+            )
+
+        def extdim(ref3, idx, r):
+            rr, l = idx // LANES, idx % LANES
+            return jnp.sum(jnp.where(lane == l, ref3[r, pl.ds(rr, 1), :], 0.0))
+
+        fits_nb = ~(has_sc & (exti(nihs_ref, nb) == 0))
+        for r in range(R8):
+            fits_nb = fits_nb & (
+                fvec_ref[r] < extdim(idle_ref, nb, r) + fvec_ref[16 + r]
+            )
+
+        oscore_ref[0] = jnp.where(any_c, big, NINF)
+        oidx_ref[0] = jnp.where(any_c, nb + off, sentinel)
+        oidx_ref[1] = (any_c & fits_nb).astype(jnp.int32)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+        ),
+        in_specs=(
+            [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 11
+        ),
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )
+
+    def step(ivec, fvec, *blocks):
+        oscore, oidx = call(ivec, fvec, *blocks)
+        return oscore[0], oidx[0], oidx[1]
+
+    return step
+
+
+def block_step_jnp(ivec, fvec, cnode, affw, nalloc, nmax, nihs, nrhs,
+                   idle, rel, used, ntasks, nports):
+    """jnp twin of the fused block step — identical folded layout and
+    float32 formulas, as plain XLA ops. The fast compiled path on
+    non-TPU meshes (virtual-CPU parity tests and bench rows) and the
+    cross-check oracle the interpret-mode kernel is pinned against."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    MAX_PRIORITY = 10
+    gid = ivec[0]
+    has_sc = ivec[1] != 0
+    tports = ivec[2]
+    off = ivec[3]
+    sentinel = ivec[4]
+    Nr_loc = nmax.shape[0]
+
+    req3 = fvec[:R8][:, None, None]
+    eps3 = fvec[16:24][:, None, None]
+    fits_idle = jnp.all(req3 < idle + eps3, axis=0) & ~(has_sc & (nihs == 0))
+    fits_rel = jnp.all(req3 < rel + eps3, axis=0) & ~(has_sc & (nrhs == 0))
+    static_ok = cnode[gid] != 0
+    room = ntasks < nmax
+    port_ok = (nports & tports) == 0
+    cand = static_ok & room & port_ok & (fits_idle | fits_rel)
+
+    req_cpu = used[0] + fvec[8]
+    req_mem = used[1] + fvec[9]
+    cap_cpu = nalloc[0]
+    cap_mem = nalloc[1]
+
+    def least_dim(rq, cp):
+        safe = jnp.where(cp == 0.0, 1.0, cp)
+        sc = jnp.floor(_ieee_div((cp - rq) * MAX_PRIORITY, safe)).astype(jnp.int32)
+        return jnp.where((cp == 0.0) | (rq > cp), 0, sc)
+
+    least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
+    cpu_f = jnp.where(
+        cap_cpu != 0.0,
+        _ieee_div(req_cpu, jnp.where(cap_cpu == 0.0, 1.0, cap_cpu)),
+        1.0,
+    )
+    mem_f = jnp.where(
+        cap_mem != 0.0,
+        _ieee_div(req_mem, jnp.where(cap_mem == 0.0, 1.0, cap_mem)),
+        1.0,
+    )
+    balanced = jnp.where(
+        (cpu_f >= 1.0) | (mem_f >= 1.0),
+        0,
+        (MAX_PRIORITY - jnp.abs(cpu_f - mem_f) * MAX_PRIORITY).astype(jnp.int32),
+    )
+    score = (
+        least.astype(jnp.float32) * fvec[24]
+        + balanced.astype(jnp.float32) * fvec[25]
+        + affw[gid]
+    )
+    nidx = (
+        lax.broadcasted_iota(jnp.int32, (Nr_loc, LANES), 0) * LANES
+        + lax.broadcasted_iota(jnp.int32, (Nr_loc, LANES), 1)
+    )
+    NINF = jnp.float32(-jnp.inf)
+    big = jnp.max(jnp.where(cand, score, NINF))
+    any_c = big > NINF
+    nb = jnp.min(jnp.where(cand & (score == big), nidx, INT_MAX))
+    nb = jnp.minimum(nb, Nr_loc * LANES - 1)
+    rr, l = nb // LANES, nb % LANES
+    fits_nb = ~(has_sc & (nihs[rr, l] == 0)) & jnp.all(
+        fvec[:R8] < idle[:, rr, l] + fvec[16:24]
+    )
+    return (
+        jnp.where(any_c, big, NINF),
+        jnp.where(any_c, nb + off, sentinel),
+        (any_c & fits_nb).astype(jnp.int32),
+    )
 
 
 class PallasSolver:

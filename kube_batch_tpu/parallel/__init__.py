@@ -15,6 +15,12 @@ let XLA place collectives.
 `sharded_solve_allocate(arrays, mesh)` is the multi-chip twin of
 `ops.solve_allocate`; blockwise node-axis scaling means a 5k-node
 snapshot occupies 5k/n_devices rows per chip.
+
+Two rungs share that mesh: `ShardedPallasSolver` (sharded_pallas.py) —
+the blocked sharded-Pallas solver, the fused block kernel per shard
+with one argmax exchange per gang iteration and a per-shard VMEM gate —
+and `ShardedSolver` (sharded.py), the GSPMD-sharded XLA while-loop
+twin it degrades to.
 """
 
 from kube_batch_tpu.parallel.sharded import (
@@ -25,9 +31,11 @@ from kube_batch_tpu.parallel.sharded import (
     sharded_solve_allocate,
     state_shardings,
 )
+from kube_batch_tpu.parallel.sharded_pallas import ShardedPallasSolver
 
 __all__ = [
     "NODE_AXIS_ARRAYS",
+    "ShardedPallasSolver",
     "ShardedSolver",
     "make_mesh",
     "node_shardings",

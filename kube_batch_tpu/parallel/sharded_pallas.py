@@ -1,0 +1,511 @@
+"""Blocked sharded-Pallas solver: the fused solve, one node block per chip.
+
+The single-chip fused Pallas kernel (ops/pallas_solve) wins by holding
+the whole snapshot in VMEM; its envelope is therefore one chip's VMEM
+budget. The GSPMD-sharded XLA twin (parallel/sharded) scales capacity
+but pays ~70us of per-HLO dispatch per gang iteration. This module is
+the missing rung between them: each device runs the **fused block-local
+kernel** — feasibility + score + block argmax over its own 128-lane
+node blocks, every node array resident in VMEM — inside one
+`jax.shard_map` SPMD program, and the only cross-device traffic is a
+**per-gang-iteration argmax exchange**: one small all-gather of each
+shard's (best score, global node index, fits-idle bit) triple over the
+mesh axis, after which every shard deterministically agrees on the
+winner and only the owning shard applies the capacity update to its
+block. Queue/job selection and the task/job/queue bookkeeping are tiny
+and run replicated (identical inputs -> identical results on every
+shard), sharing `ops.kernels.select_queue_job` with the XLA twin so the
+paths cannot drift on selection numerics.
+
+Capacity therefore scales with mesh size: the per-shard VMEM claim is
+the node block only (`ops.pallas_solve.block_vmem_bytes`), so a
+snapshot that overflows `vmem_budget()` on one chip stays on the Pallas
+rung when `node_block_bytes / mesh_size` fits — instead of falling to
+the XLA twin (the 4.5s-vs-0.5s cliff BENCH_r05 measured at 50k x 5k).
+
+Block backends (``KBT_MESH_PALLAS`` or the ``block_impl`` argument):
+
+- ``mosaic`` — the real TPU kernel (auto-selected on TPU meshes);
+- ``interpret`` — the same kernel through the Pallas interpreter
+  (traceable, so it compiles inside the SPMD program; how the CPU
+  parity tests execute the kernel code bit-for-bit);
+- ``jnp`` — a plain-XLA twin of the block step (the fast path on
+  virtual-CPU meshes and the oracle the kernel is pinned against).
+
+Speaks the same `SolveState` resume protocol as `ShardedSolver`, so the
+action's segmented pod-affinity pause/resume hybrid works unchanged,
+including the live InterPodAffinity re-fold between segments.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kube_batch_tpu.ops import pallas_solve as ps
+from kube_batch_tpu.ops.kernels import (
+    KIND_ALLOCATED,
+    KIND_PIPELINED,
+    SolveState,
+    init_state,
+    select_queue_job,
+)
+from kube_batch_tpu.parallel.sharded import AXIS_NAME, NODE_AXIS_ARRAYS
+
+LANES = ps.LANES
+R8 = ps.R8
+
+# Arrays the replicated loop body never reads (node-axis arrays travel
+# folded+sharded; affinity/compat are pre-folded into cnode/affw).
+_DROP = frozenset(NODE_AXIS_ARRAYS) | {"pod_sc", "aff_sc", "compat"}
+
+
+def _resolve_block_impl(spec: Optional[str], mesh: Mesh) -> str:
+    if spec is None:
+        spec = os.environ.get("KBT_MESH_PALLAS", "auto")
+    spec = (spec or "auto").strip().lower()
+    if spec not in ("auto", "mosaic", "interpret", "jnp"):
+        raise ValueError(f"unknown block impl {spec!r}")
+    if spec == "auto":
+        plat = next(iter(mesh.devices.flat)).platform
+        return "mosaic" if plat == "tpu" else "jnp"
+    return spec
+
+
+class ShardedPallasSolver:
+    """Per-execute driver for the blocked sharded solve: fold the node
+    statics once, then solve / resume through the cached SPMD program."""
+
+    def __init__(
+        self,
+        arrays: dict,
+        mesh: Mesh,
+        enable_drf: bool = False,
+        enable_proportion: bool = False,
+        axis_name: str = AXIS_NAME,
+        block_impl: Optional[str] = None,
+    ) -> None:
+        if np.dtype(np.asarray(arrays["task_req"]).dtype) != np.float32:
+            raise ValueError(
+                "blocked sharded-Pallas solve is float32-only (like the "
+                "single-chip fused kernel); encode with dtype=float32"
+            )
+        self.a = arrays
+        self.mesh = mesh
+        self.axis_name = axis_name
+        m = mesh.devices.size
+        n_nodes = arrays["node_idle"].shape[0]
+        nr = ps._rows(n_nodes)
+        # The folded row axis pads up to a multiple of the mesh size so
+        # shard_map divides it evenly; pad rows carry cnode=0/nmax=0 and
+        # can never be candidates.
+        self.nr_pad = -(-nr // m) * m
+        self.block_impl = _resolve_block_impl(block_impl, mesh)
+        self._statics = self._fold_statics(arrays)
+        self._tports = ps._ports_mask(np.asarray(arrays["task_ports"]))
+        self._pod_sc = arrays.get("pod_sc")  # identity marker for refresh
+        self._fresh, self._resume = _blocked_programs(
+            tuple(mesh.devices.flat),
+            axis_name,
+            enable_drf,
+            enable_proportion,
+            self.block_impl,
+        )
+
+    def _fold_statics(self, a: dict) -> dict:
+        f32, i32 = np.float32, np.int32
+        node_gid = np.asarray(a["node_gid"], np.int64)
+        okv = np.asarray(a["node_ok"] & a["node_valid"])
+        cnode_full = np.asarray(a["compat"])[:, node_gid] & okv[None, :]
+        gt, n = cnode_full.shape
+        cnode = np.zeros((gt, self.nr_pad, LANES), i32)
+        cnode[:, : (n + LANES - 1) // LANES, :].reshape(gt, -1)[:, :n] = cnode_full
+        return {
+            "cnode": cnode,
+            "affw": ps.fold_affinity_scores(a, self.nr_pad),
+            "nalloc": ps._fold2(np.asarray(a["node_alloc"], f32), self.nr_pad, f32),
+            "nmax": ps._fold1(np.asarray(a["node_max_tasks"], i32), self.nr_pad, i32),
+            "nihs": ps._fold1(np.asarray(a["node_idle_has_sc"], i32), self.nr_pad, i32),
+            "nrhs": ps._fold1(np.asarray(a["node_rel_has_sc"], i32), self.nr_pad, i32),
+        }
+
+    def solve(self, state: Optional[SolveState]) -> SolveState:
+        if self.a.get("pod_sc") is not self._pod_sc:
+            # The action recomputed live InterPodAffinity scores after a
+            # host-stepped pod landed: re-fold just the affinity static
+            # and resume with fresh scores (same contract as the
+            # single-chip PallasSolver).
+            self._pod_sc = self.a.get("pod_sc")
+            self._statics["affw"] = ps.fold_affinity_scores(self.a, self.nr_pad)
+        a_call = dict(self.a)
+        a_call["_tports"] = self._tports
+        if state is None:
+            return self._fresh(a_call, self._statics)
+        return self._resume(a_call, self._statics, state)
+
+
+@lru_cache(maxsize=16)
+def _blocked_programs(
+    devices: tuple,
+    axis_name: str,
+    enable_drf: bool,
+    enable_proportion: bool,
+    block_impl: str,
+):
+    """(fresh, resume) jitted SPMD programs for a mesh + block backend.
+    Keyed on the device tuple and static flags; shapes (and the derived
+    Nr_pad/Nr_loc/GT block geometry) are left to jit's per-signature
+    cache, so stable encode buckets hit the compiled program across
+    cycles."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    try:  # jax >= 0.6 exports shard_map at the top level
+        from jax import shard_map  # type: ignore[attr-defined]
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.asarray(devices), (axis_name,))
+    m = len(devices)
+    spec3 = P(None, axis_name, None)
+    spec2 = P(axis_name, None)
+    sh_specs = {
+        "cnode": spec3, "affw": spec3, "nalloc": spec3,
+        "nmax": spec2, "nihs": spec2, "nrhs": spec2,
+        "idle": spec3, "rel": spec3, "used": spec3,
+        "ntasks": spec2, "nports": spec2,
+    }
+    out_sh_specs = {
+        "idle": spec3, "rel": spec3, "used": spec3,
+        "ntasks": spec2, "nports": spec2,
+    }
+    INT_MAX = ps.INT_MAX
+    NINF = float("-inf")
+
+    def local(rep, a, sh):
+        """One shard's SPMD body: the full gang loop over the local node
+        block, replicated selection/bookkeeping, one argmax exchange per
+        iteration."""
+        i32, f32 = jnp.int32, jnp.float32
+        T, R = a["task_req"].shape
+        J = a["job_min"].shape[0]
+        Q = a["queue_rank"].shape[0]
+        gt = sh["cnode"].shape[0]
+        nr_loc = sh["cnode"].shape[1]
+        sent = nr_loc * m * LANES  # global padded N: "no candidate"
+        axis_idx = lax.axis_index(axis_name).astype(i32)
+        off = axis_idx * (nr_loc * LANES)
+
+        if block_impl == "jnp":
+            block = ps.block_step_jnp
+        else:
+            block = ps._build_block_step(nr_loc, gt, block_impl == "interpret")
+
+        eps8 = jnp.concatenate(
+            [jnp.asarray(a["eps"], f32), jnp.ones(R8 - R, f32)]
+        )
+        wvec = jnp.stack(
+            [jnp.asarray(a["w_least"], f32), jnp.asarray(a["w_balanced"], f32)]
+        )
+        fpad = jnp.zeros(ps.FVEC_LEN - 3 * R8 - 2, f32)
+        host_only = a["task_host_only"]
+        max_iter = jnp.int32(T + J + Q + 1) + jnp.sum(host_only).astype(i32)
+        lane1 = lax.broadcasted_iota(i32, (1, LANES), 1)
+
+        def body(s: SolveState) -> SolveState:
+            # -- replicated queue + job selection (shared with the XLA twin)
+            need_sel = s.cur < 0
+            qsel, q_any, overused, jsel, j_any = select_queue_job(
+                a, s, enable_drf, enable_proportion
+            )
+            drop_q = need_sel & q_any & overused
+            sel_ok = q_any & ~overused & j_any
+            cur = jnp.where(need_sel, jnp.where(sel_ok, jsel, -1), s.cur)
+            job_active = jnp.where(
+                drop_q, s.job_active & (a["job_queue"] != qsel), s.job_active
+            )
+            q_dropped = s.q_dropped.at[qsel].set(drop_q | s.q_dropped[qsel])
+
+            # -- pop the current job's next pending task (O(1) pointer) ----
+            cur_c = jnp.maximum(cur, 0)
+            t = s.ptr[cur_c]
+            t_any = (cur >= 0) & (t < a["job_end"][cur_c])
+            t = jnp.minimum(t, T - 1)
+            drop = (cur >= 0) & ~t_any
+            pause = t_any & host_only[t]
+            proc = t_any & ~pause
+
+            # -- fused block-local feasibility + score + argmax ------------
+            req8 = jnp.concatenate(
+                [jnp.asarray(a["task_req"][t], f32), jnp.zeros(R8 - R, f32)]
+            )
+            res8 = jnp.concatenate(
+                [jnp.asarray(a["task_res"][t], f32), jnp.zeros(R8 - R, f32)]
+            )
+            gid = jnp.clip(a["task_gid"][t], 0, gt - 1).astype(i32)
+            tports = a["_tports"][t]
+            fvec = jnp.concatenate([req8, res8, eps8, wvec, fpad])
+            ivec = jnp.stack(
+                [
+                    gid,
+                    a["task_has_sc"][t].astype(i32),
+                    tports,
+                    off,
+                    jnp.int32(sent),
+                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                ]
+            )
+            bscore, bidx, bfits = block(
+                ivec, fvec,
+                sh["cnode"], sh["affw"], sh["nalloc"],
+                sh["nmax"], sh["nihs"], sh["nrhs"],
+                s.idle, s.rel, s.used, s.ntasks, s.nports,
+            )
+
+            # -- the cross-chip argmax exchange: one packed all-gather per
+            # gang iteration; every shard then derives the same winner
+            # (max score, min global node index on ties — identical to
+            # the single-chip tie-break) and the winner's fits-idle bit
+            # comes from the shard that owns it.
+            packed = jnp.stack(
+                [bscore, bidx.astype(f32), bfits.astype(f32)]
+            )
+            allp = lax.all_gather(packed, axis_name)  # [mesh, 3]
+            scores = allp[:, 0]
+            idxs = allp[:, 1].astype(i32)
+            fits = allp[:, 2].astype(i32)
+            big = jnp.max(scores)
+            any_cand = big > NINF
+            nb = jnp.min(jnp.where(scores == big, idxs, INT_MAX))
+            nb = jnp.minimum(nb, sent - 1)
+            fits_idle_nb = (
+                jnp.sum(jnp.where((scores == big) & (idxs == nb), fits, 0)) > 0
+            )
+
+            abandon = proc & ~any_cand
+            assign = proc & any_cand
+            do_alloc = assign & fits_idle_nb
+
+            # -- capacity update: owning shard only, one 128-lane slab ----
+            rloc = nb // LANES - axis_idx * nr_loc
+            mine = (rloc >= 0) & (rloc < nr_loc)
+            rc = jnp.clip(rloc, 0, nr_loc - 1)
+            l = nb % LANES
+            upd = assign & mine
+            lmask = upd & (lane1 == l)  # [1, 128]
+            lmask3 = lmask[None]  # [1, 1, 128]
+            col_alloc = jnp.where(do_alloc, res8, 0.0)[:, None, None]
+            col_pipe = jnp.where(do_alloc, 0.0, res8)[:, None, None]
+            res3 = res8[:, None, None]
+
+            z = jnp.int32(0)  # index literals pinned to rc's dtype (x64)
+
+            def slab_update(arr, delta3):
+                slab = lax.dynamic_slice(arr, (z, rc, z), (R8, 1, LANES))
+                slab = slab + jnp.where(lmask3, delta3, 0.0)
+                return lax.dynamic_update_slice(arr, slab, (z, rc, z))
+
+            idle = slab_update(s.idle, -col_alloc)
+            rel = slab_update(s.rel, -col_pipe)
+            used = slab_update(s.used, res3)
+            nt_row = lax.dynamic_slice(s.ntasks, (rc, z), (1, LANES))
+            nt_row = nt_row + jnp.where(lmask, 1, 0)
+            ntasks = lax.dynamic_update_slice(s.ntasks, nt_row, (rc, z))
+            np_row = lax.dynamic_slice(s.nports, (rc, z), (1, LANES))
+            np_row = np_row | jnp.where(lmask, tports, 0)
+            nports = lax.dynamic_update_slice(s.nports, np_row, (rc, z))
+
+            # -- replicated bookkeeping (identical on every shard) ---------
+            ready_cnt = s.ready_cnt.at[cur_c].add(jnp.where(do_alloc, 1, 0))
+            ptr = s.ptr.at[cur_c].add(jnp.where(proc, 1, 0))
+            assigned_node = s.assigned_node.at[t].set(
+                jnp.where(assign, nb, s.assigned_node[t])
+            )
+            kind = jnp.where(
+                do_alloc, KIND_ALLOCATED, jnp.where(assign, KIND_PIPELINED, 0)
+            )
+            assigned_kind = s.assigned_kind.at[t].set(
+                jnp.where(assign, kind, s.assigned_kind[t])
+            )
+            assign_pos = s.assign_pos.at[t].set(
+                jnp.where(assign, s.step, s.assign_pos[t])
+            )
+            add_row = jnp.where(assign, a["task_res"][t], jnp.zeros(R, f32))
+            job_alloc = (
+                s.job_alloc.at[cur_c].add(add_row) if enable_drf else s.job_alloc
+            )
+            if enable_proportion:
+                qcur = a["job_queue"][cur_c]
+                q_alloc = s.q_alloc.at[qcur].add(add_row)
+                q_alloc_has_sc = s.q_alloc_has_sc.at[qcur].set(
+                    s.q_alloc_has_sc[qcur] | (assign & a["task_res_has_sc"][t])
+                )
+            else:
+                q_alloc = s.q_alloc
+                q_alloc_has_sc = s.q_alloc_has_sc
+
+            job_active = job_active.at[cur_c].set(
+                jnp.where(drop | abandon, False, job_active[cur_c])
+            )
+            ready_now = ready_cnt[cur_c] >= a["job_min"][cur_c]
+            cur_next = jnp.where(drop | abandon | (proc & ready_now), -1, cur)
+
+            return SolveState(
+                it=s.it + 1,
+                step=s.step + assign.astype(i32),
+                cur=cur_next,
+                ptr=ptr,
+                assigned_node=assigned_node,
+                assigned_kind=assigned_kind,
+                assign_pos=assign_pos,
+                idle=idle,
+                rel=rel,
+                used=used,
+                ntasks=ntasks,
+                nports=nports,
+                ready_cnt=ready_cnt,
+                job_active=job_active,
+                q_dropped=q_dropped,
+                job_alloc=job_alloc,
+                q_alloc=q_alloc,
+                q_alloc_has_sc=q_alloc_has_sc,
+                paused_at=jnp.where(pause, t, jnp.int32(-1)),
+            )
+
+        def cond(s: SolveState):
+            return (
+                ((s.cur >= 0) | jnp.any(s.job_active))
+                & (s.it < max_iter)
+                & (s.paused_at < 0)
+            )
+
+        (
+            it, step, cur, ptr, an, ak, ap,
+            ready_cnt, job_active, q_dropped, job_alloc, q_alloc, qahs, paused,
+        ) = rep
+        state = SolveState(
+            it=it, step=step, cur=cur, ptr=ptr,
+            assigned_node=an, assigned_kind=ak, assign_pos=ap,
+            idle=sh["idle"], rel=sh["rel"], used=sh["used"],
+            ntasks=sh["ntasks"], nports=sh["nports"],
+            ready_cnt=ready_cnt, job_active=job_active, q_dropped=q_dropped,
+            job_alloc=job_alloc, q_alloc=q_alloc, q_alloc_has_sc=qahs,
+            paused_at=paused,
+        )
+        out = lax.while_loop(cond, body, state)
+        rep_out = (
+            out.it, out.step, out.cur, out.ptr,
+            out.assigned_node, out.assigned_kind, out.assign_pos,
+            out.ready_cnt, out.job_active, out.q_dropped,
+            out.job_alloc, out.q_alloc, out.q_alloc_has_sc, out.paused_at,
+        )
+        sh_out = {
+            "idle": out.idle, "rel": out.rel, "used": out.used,
+            "ntasks": out.ntasks, "nports": out.nports,
+        }
+        return rep_out, sh_out
+
+    smapped = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), sh_specs),
+        out_specs=(P(), out_sh_specs),
+        check_rep=False,
+    )
+
+    def run(a: dict, statics: dict, state: Optional[SolveState]) -> SolveState:
+        i32, f32 = jnp.int32, jnp.float32
+        n = a["node_idle"].shape[0]
+        R = a["task_req"].shape[1]
+        p = a["task_ports"].shape[1]
+        nr_pad = statics["cnode"].shape[1]
+        nf = nr_pad * LANES
+
+        if state is None:
+            state = init_state(
+                a, enable_drf=enable_drf, enable_proportion=enable_proportion
+            )
+        state = state._replace(paused_at=jnp.int32(-1))
+
+        def fold2(x):
+            xp = jnp.pad(
+                jnp.asarray(x, f32), ((0, nf - n), (0, R8 - R))
+            )
+            return xp.reshape(nr_pad, LANES, R8).transpose(2, 0, 1)
+
+        def fold1(x, dt):
+            return jnp.pad(jnp.asarray(x, dt), (0, nf - n)).reshape(nr_pad, LANES)
+
+        if p:
+            bits = jnp.sum(
+                jnp.asarray(state.nports, i32)
+                * (jnp.int32(1) << jnp.arange(p, dtype=i32))[None, :],
+                axis=1,
+                dtype=i32,
+            )
+        else:
+            bits = jnp.zeros(n, i32)
+
+        sh_in = dict(statics)
+        sh_in.update(
+            idle=fold2(state.idle),
+            rel=fold2(state.rel),
+            used=fold2(state.used),
+            ntasks=fold1(state.ntasks, i32),
+            nports=fold1(bits, i32),
+        )
+        rep_in = (
+            jnp.asarray(state.it, i32), jnp.asarray(state.step, i32),
+            jnp.asarray(state.cur, i32), jnp.asarray(state.ptr, i32),
+            jnp.asarray(state.assigned_node, i32),
+            jnp.asarray(state.assigned_kind, i32),
+            jnp.asarray(state.assign_pos, i32),
+            jnp.asarray(state.ready_cnt, i32),
+            jnp.asarray(state.job_active, bool),
+            jnp.asarray(state.q_dropped, bool),
+            jnp.asarray(state.job_alloc, f32),
+            jnp.asarray(state.q_alloc, f32),
+            jnp.asarray(state.q_alloc_has_sc, bool),
+            state.paused_at,
+        )
+        a_rep = {k: v for k, v in a.items() if k not in _DROP}
+        rep_out, sh_out = smapped(rep_in, a_rep, sh_in)
+
+        def unfold2(x):
+            return x.transpose(1, 2, 0).reshape(nf, R8)[:n, :R]
+
+        def unfold1(x):
+            return x.reshape(nf)[:n]
+
+        obits = unfold1(sh_out["nports"])
+        if p:
+            nports_bool = (
+                (obits[:, None] >> jnp.arange(p, dtype=i32)[None, :]) & 1
+            ) != 0
+        else:
+            nports_bool = jnp.zeros((n, 0), bool)
+        (
+            it, step, cur, ptr, an, ak, ap,
+            ready_cnt, job_active, q_dropped, job_alloc, q_alloc, qahs, paused,
+        ) = rep_out
+        return SolveState(
+            it=it, step=step, cur=cur, ptr=ptr,
+            assigned_node=an, assigned_kind=ak, assign_pos=ap,
+            idle=unfold2(sh_out["idle"]),
+            rel=unfold2(sh_out["rel"]),
+            used=unfold2(sh_out["used"]),
+            ntasks=unfold1(sh_out["ntasks"]),
+            nports=nports_bool,
+            ready_cnt=ready_cnt, job_active=job_active, q_dropped=q_dropped,
+            job_alloc=job_alloc, q_alloc=q_alloc, q_alloc_has_sc=qahs,
+            paused_at=paused,
+        )
+
+    fresh = jax.jit(partial(run, state=None))
+    resume = jax.jit(run)
+    return fresh, resume
