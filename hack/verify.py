@@ -20,7 +20,13 @@ Runs, in order:
    elector — plus a seeded cache-mutation-detector violation, each
    through a real scheduling path, asserting binds still land.
 
-Exit 0 iff every gate is clean. Usage:  python hack/verify.py [--strict]
+With ``--chaos``, two more gates run: the chaos-marked pytest subset
+(tests/test_faults.py + tests/test_recovery.py — fault drills, the
+crash-consistent failover e2e), and ``kube_batch_tpu.recovery.fsck``
+against a seeded journal fixture (a known half-confirmed WAL must fsck
+clean with the expected orphan count, and ``--strict`` must gate on it).
+
+Exit 0 iff every gate is clean. Usage:  python hack/verify.py [--strict] [--chaos]
 
 CI/the deployment image run ``--strict`` (the Dockerfile installs ruff +
 mypy via the ``dev`` extra); the bare container, which cannot install
@@ -206,10 +212,73 @@ def run_optional(tool: str, args: list[str]) -> int | None:
     return res.returncode
 
 
+def seeded_journal_fixture(path: str) -> None:
+    """A known WAL: 3 bind intents for one gang, first confirmed —
+    exactly what a leader killed after 1 of 3 bulk writes leaves."""
+    lines = [
+        '{"rec":"intent","seq":1,"cycle":4,"op":"bind","gang":"default/g0","pod":"default/p0","node":"n0"}',
+        '{"rec":"intent","seq":2,"cycle":4,"op":"bind","gang":"default/g0","pod":"default/p1","node":"n1"}',
+        '{"rec":"intent","seq":3,"cycle":4,"op":"bind","gang":"default/g0","pod":"default/p2","node":"n0"}',
+        '{"rec":"confirm","seq":1}',
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def run_chaos_gate(env: dict) -> bool:
+    """--chaos: the chaos-marked test subset + fsck on a seeded journal.
+    Returns True when clean."""
+    import json
+    import tempfile
+
+    ok = True
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests", "-q", "-m", "chaos",
+            "-p", "no:cacheprovider",
+        ],
+        cwd=REPO, env=env,
+    )
+    if res.returncode != 0:
+        print("verify: chaos test subset FAILED")
+        ok = False
+    with tempfile.TemporaryDirectory() as tmp:
+        fixture = os.path.join(tmp, "seeded.wal")
+        seeded_journal_fixture(fixture)
+        res = subprocess.run(
+            [sys.executable, "-m", "kube_batch_tpu.recovery.fsck", "--json", fixture],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        summary = {}
+        if res.returncode == 0:
+            try:
+                summary = json.loads(res.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                pass
+        if (
+            res.returncode != 0
+            or summary.get("intents") != 3
+            or summary.get("orphaned") != 2
+            or summary.get("corrupt_lines") != 0
+        ):
+            print(f"verify: recovery.fsck on the seeded journal FAILED ({summary})")
+            ok = False
+        # --strict must refuse a journal with in-flight intents
+        res = subprocess.run(
+            [sys.executable, "-m", "kube_batch_tpu.recovery.fsck", "--strict", fixture],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        if res.returncode != 1:
+            print("verify: recovery.fsck --strict did not gate on orphans")
+            ok = False
+    return ok
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     strict = "--strict" in argv
-    unknown = [a for a in argv if a not in ("--strict",)]
+    chaos = "--chaos" in argv
+    unknown = [a for a in argv if a not in ("--strict", "--chaos")]
     if unknown:
         print(f"verify: unknown argument(s): {' '.join(unknown)}")
         return 2
@@ -279,6 +348,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     if res.returncode != 0:
         print("verify: chaos smoke FAILED")
+        failed = True
+
+    # 6. --chaos: the full chaos-marked suite + fsck on a seeded journal
+    if chaos and not run_chaos_gate(env):
         failed = True
 
     print("verify:", "FAILED" if failed else "ok",

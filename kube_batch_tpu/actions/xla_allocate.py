@@ -244,12 +244,20 @@ class XlaAllocateAction(Action):
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
-        solve_fn = self._make_solver(arrays, enable_drf, enable_proportion, dtype, mesh)
+        # Cycle deadline budget (recovery/budget.py), threaded from
+        # run_once via the session: the solver entry receives the
+        # remaining budget and every pre-dispatch boundary checks it.
+        budget = getattr(ssn, "cycle_budget", None)
+        solve_fn = self._make_solver(
+            arrays, enable_drf, enable_proportion, dtype, mesh, budget=budget
+        )
 
         t0 = _time.perf_counter()
         try:
             state = solve_fn(None)
             while int(state.paused_at) >= 0:
+                if budget is not None:
+                    budget.check("between solve segments")
                 # Segmented hybrid: sync the session up to the pause point,
                 # serial-step the host-only task, resume the kernel.
                 s = jax.tree_util.tree_map(np.array, state)  # writable host copy
@@ -289,6 +297,12 @@ class XlaAllocateAction(Action):
         t_solve = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
+        if budget is not None:
+            # The last pre-dispatch gate: past this point binds reach
+            # the cache and the cycle can no longer abort cleanly. The
+            # cycle.overrun drill injects here (inject=True) — maximal
+            # discardable work, zero cache mutation.
+            budget.check("dispatch barrier", inject=True)
         replay.finish(np.asarray(result.ready_cnt))
         self.last_timings = {
             "encode_s": t_encode,
@@ -405,6 +419,7 @@ class XlaAllocateAction(Action):
         enable_proportion: bool,
         dtype,
         mesh=None,
+        budget=None,
     ):
         """Pick the device solve: with a conf-selected multi-chip mesh,
         the GSPMD node-axis-sharded XLA kernel (parallel.ShardedSolver);
@@ -427,6 +442,21 @@ class XlaAllocateAction(Action):
         from kube_batch_tpu.ops.kernels import solve_allocate_state
 
         ladder = faults.solver_ladder
+
+        def _with_budget(fn):
+            """Solver-entry budget gate: a device solve is the cycle's
+            dominant cost, so a hard budget already gone must abort
+            BEFORE another segment dispatches — outside the tier
+            try/except blocks, so the abort cannot be mistaken for a
+            tier failure and feed a breaker."""
+            if budget is None:
+                return fn
+
+            def checked(st):
+                budget.check("solver entry")
+                return fn(st)
+
+            return checked
 
         def _xla_solve(st):
             # The device floor. Failures (organic or the solve.xla fault
@@ -549,13 +579,13 @@ class XlaAllocateAction(Action):
                             mp = None
                     return solve_sharded(st)
 
-                return solve_mesh_pallas
+                return _with_budget(solve_mesh_pallas)
             if xla_sharded is not None:
                 log.info(
                     "solving with node-axis-sharded XLA kernel over a "
                     "%d-device mesh", mesh.devices.size,
                 )
-                return solve_sharded
+                return _with_budget(solve_sharded)
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None
@@ -597,7 +627,7 @@ class XlaAllocateAction(Action):
                     solver = None
             return _xla_solve(st)
 
-        return solve_fn
+        return _with_budget(solve_fn)
 
     # -- host-side serial step for one pod-affinity task ---------------------
 

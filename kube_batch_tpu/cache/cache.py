@@ -415,11 +415,25 @@ class SchedulerCache:
         evictor=None,
         status_updater=None,
         volume_binder=None,
+        journal=None,
+        staleness_fn=None,
     ) -> None:
         self._mutex = threading.RLock()
         self.store = store
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # Crash consistency (recovery/): when a WriteIntentJournal is
+        # attached, every bind/evict appends an intent BEFORE its store
+        # write dispatches and confirms AFTER the write acks, so a
+        # takeover can reconcile the in-flight set instead of guessing.
+        self.journal = journal
+        # Scheduling cycle id, stamped into journal records; the
+        # scheduler loop advances it each run_once.
+        self.cycle = 0
+        # Bounded-staleness hook: a watch-fed deployment wires the
+        # watcher's snapshot_age here; the in-process store is
+        # synchronously consistent (age 0).
+        self._staleness_fn = staleness_fn
 
         self.jobs: dict[str, JobInfo] = {}
         self.nodes: dict[str, NodeInfo] = {}
@@ -445,6 +459,18 @@ class SchedulerCache:
                 os.environ.get("KBT_WRITE_RETRIES"),
             )
             self._write_retries = 2
+        # errTasks terminal drop: a permanently-rejected write must not
+        # ride the resync queue forever (see _process_resync_task).
+        try:
+            self._resync_max_retries = max(
+                1, int(os.environ.get("KBT_RESYNC_MAX_RETRIES", "15"))
+            )
+        except ValueError:
+            log.errorf(
+                "KBT_RESYNC_MAX_RETRIES=%r is not an integer; using 15",
+                os.environ.get("KBT_RESYNC_MAX_RETRIES"),
+            )
+            self._resync_max_retries = 15
         self._writer: Optional[ThreadPoolExecutor] = None
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -546,6 +572,16 @@ class SchedulerCache:
         """The store replays existing objects at subscription, so the
         mirror is synchronously warm (reference cache.go:327-348)."""
         return self._synced
+
+    def snapshot_age(self) -> float:
+        """Seconds the mirror may lag the source of truth — 0 for the
+        in-process store (synchronous event dispatch); a watch-fed
+        deployment wires its watcher's snapshot_age via staleness_fn.
+        The scheduler's refuse-to-schedule guard (KBT_MAX_SNAPSHOT_AGE_S)
+        reads this every cycle."""
+        if self._staleness_fn is not None:
+            return float(self._staleness_fn())
+        return 0.0
 
     def _worker(self, fn) -> None:
         while not self._stop.is_set():
@@ -832,6 +868,32 @@ class SchedulerCache:
             raise KeyError(f"failed to find task {ti.uid} in status {ti.status}")
         return job, task
 
+    # -- write-intent journal hooks (recovery/journal.py) ------------------
+
+    def _journal_intents(self, op: str, entries: list) -> list:
+        """Append-before-dispatch; a journal failure degrades to an
+        unjournaled dispatch, loudly — availability over protection."""
+        if self.journal is None or not entries:
+            return [None] * len(entries)
+        try:
+            return self.journal.append_intents(op, entries, cycle=self.cycle)
+        except Exception as e:  # noqa: BLE001 - disk full / injected fault
+            metrics.register_journal_records("append_failed", len(entries))
+            log.errorf(
+                "journal append failed (%s); dispatching %d %s write(s) "
+                "unjournaled", e, len(entries), op,
+            )
+            return [None] * len(entries)
+
+    def _journal_confirm(self, seq) -> None:
+        """Confirm-after-ack (no-op for unjournaled writes)."""
+        if seq is None or self.journal is None:
+            return
+        try:
+            self.journal.confirm(seq)
+        except Exception as e:  # noqa: BLE001
+            log.errorf("journal confirm of seq %s failed: %s", seq, e)
+
     def bind(self, ti: TaskInfo, hostname: str) -> None:
         """Mirror update now, API write async; failure resyncs
         (reference cache.go:404-448)."""
@@ -844,7 +906,10 @@ class SchedulerCache:
             task.node_name = hostname
             node.add_task(task)
             pod = task.pod
-        self._submit_write(self._do_bind, pod, hostname, task)
+        seqs = self._journal_intents(
+            "bind", [(task.job, f"{pod.namespace}/{pod.name}", hostname)]
+        )
+        self._submit_write(self._do_bind, pod, hostname, task, seqs[0])
 
     def bind_many(self, pairs: list, keys=None) -> None:
         """Bulk bind for the replay path: the per-bind net effect of
@@ -879,11 +944,25 @@ class SchedulerCache:
                 resolved.append((task.pod, hostname, task))
         for ti in failed:
             self.resync_task(ti)
-        self._submit_write(self._do_bind_many, resolved)
+        # One journal append covers the whole bulk statement (the gang
+        # ids ride per entry), flushed before the batch dispatches — a
+        # leader killed mid-batch leaves exactly the unconfirmed suffix
+        # for the standby's reconciliation.
+        seqs = self._journal_intents(
+            "bind",
+            [
+                (task.job, f"{pod.namespace}/{pod.name}", hostname)
+                for pod, hostname, task in resolved
+            ],
+        )
+        self._submit_write(
+            self._do_bind_many,
+            [(p, h, t, s) for (p, h, t), s in zip(resolved, seqs)],
+        )
 
     def _do_bind_many(self, resolved: list) -> None:
-        for pod, hostname, task in resolved:
-            self._do_bind(pod, hostname, task)
+        for pod, hostname, task, seq in resolved:
+            self._do_bind(pod, hostname, task, seq)
 
     def _write_with_retry(self, op: str, what: str, fn) -> None:
         """Bounded in-place retry with exponential backoff + jitter for
@@ -917,14 +996,18 @@ class SchedulerCache:
                 time.sleep(delay * (0.5 + random.random()))
                 delay = min(delay * 2.0, 0.5)
 
-    def _do_bind(self, pod: Pod, hostname: str, task: TaskInfo) -> None:
+    def _do_bind(self, pod: Pod, hostname: str, task: TaskInfo, seq=None) -> None:
         try:
             self._write_with_retry(
                 "bind",
                 f"<{pod.namespace}/{pod.name}>",
                 lambda: self.binder.bind(pod, hostname),
             )
+            self._journal_confirm(seq)
         except Exception as e:  # noqa: BLE001 - any write failure resyncs
+            # the journal intent stays unconfirmed: either the resync
+            # path lands the write later or the next takeover's
+            # reconciliation re-drives it (both idempotent)
             log.errorf("Failed to bind pod <%s/%s>: %s", pod.namespace, pod.name, e)
             self.resync_task(task)
 
@@ -938,15 +1021,19 @@ class SchedulerCache:
             job.update_task_status(task, TaskStatus.RELEASING)
             node.update_task(task)
             pod = task.pod
-        self._submit_write(self._do_evict, pod, task)
+        seqs = self._journal_intents(
+            "evict", [(task.job, f"{pod.namespace}/{pod.name}", "")]
+        )
+        self._submit_write(self._do_evict, pod, task, seqs[0])
 
-    def _do_evict(self, pod: Pod, task: TaskInfo) -> None:
+    def _do_evict(self, pod: Pod, task: TaskInfo, seq=None) -> None:
         try:
             self._write_with_retry(
                 "evict",
                 f"<{pod.namespace}/{pod.name}>",
                 lambda: self.evictor.evict(pod),
             )
+            self._journal_confirm(seq)
         except Exception as e:  # noqa: BLE001
             log.errorf("Failed to evict pod <%s/%s>: %s", pod.namespace, pod.name, e)
             self.resync_task(task)
@@ -970,8 +1057,26 @@ class SchedulerCache:
             self._sync_task(task)
             self._err_tasks.forget(task)
         except Exception as e:  # noqa: BLE001
-            log.errorf("Failed to sync pod <%s/%s>, retry: %s", task.namespace, task.name, e)
-            self._err_tasks.add_rate_limited(task)
+            # Per-task retry budget: a permanently-rejected write (pod
+            # poisoned, store rejecting the key forever) must not ride
+            # the queue forever — after the budget it drops terminally,
+            # metered and narrated; the task's pod stays whatever the
+            # store says it is, which a later event or takeover
+            # reconciliation can still repair.
+            if self._err_tasks.failures(task) >= self._resync_max_retries:
+                metrics.register_resync_drop()
+                log.errorf(
+                    "Giving up on resync of pod <%s/%s> after %d attempts "
+                    "(terminal drop): %s",
+                    task.namespace, task.name, self._resync_max_retries, e,
+                )
+                self._err_tasks.forget(task)
+            else:
+                log.errorf(
+                    "Failed to sync pod <%s/%s>, retry: %s",
+                    task.namespace, task.name, e,
+                )
+                self._err_tasks.add_rate_limited(task)
         finally:
             self._err_tasks.done(task)
 

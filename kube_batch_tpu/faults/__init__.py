@@ -87,6 +87,11 @@ POINTS = (
     "watch.drop",       # stream drop: poll returns 410-Gone, client re-lists
     # lease elector (server.py)
     "lease.renew",      # renewal round-trip fails (arbiter partition/timeout)
+    # crash-consistent failover (recovery/)
+    "journal.append",   # WAL append fails -> write dispatches unjournaled, loudly
+    "journal.replay",   # journal unreadable at takeover -> resync self-heal
+    "reconcile.scan",   # takeover scan dies mid-way -> partial, rescheduling heals
+    "cycle.overrun",    # injected wedged solve -> hard-deadline abort pre-dispatch
     # native extension boundary (ops/, the bulk replay)
     "native.load",      # extension unavailable for the cycle -> Python twins
     "native.prepass",   # bulk_assign prepass raises -> Python replay

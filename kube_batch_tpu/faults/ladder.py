@@ -112,6 +112,18 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._transition(OPEN)
 
+    def trip(self) -> None:
+        """Force-open regardless of the failure count — for evidence the
+        automaton's own counters cannot see, e.g. the scheduler's
+        consecutive soft-deadline overruns (a slow-but-*successful*
+        solve records a success each cycle, so per-call failures never
+        accumulate). Recovery is the normal half-open probe path."""
+        with self._lock:
+            self.failures = self.failure_threshold
+            if self.state != OPEN:
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
     def reset(self) -> None:
         with self._lock:
             self.failures = 0
@@ -145,6 +157,11 @@ class DegradationLadder:
         b = self.breakers.get(tier)
         if b is not None:
             b.record_failure()
+
+    def trip(self, tier: str) -> None:
+        b = self.breakers.get(tier)
+        if b is not None:
+            b.trip()
 
     def state(self, tier: str) -> str:
         b = self.breakers.get(tier)

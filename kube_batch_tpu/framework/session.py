@@ -520,20 +520,25 @@ def open_session(
     return ssn
 
 
-def close_session(ssn: Session) -> None:
+def close_session(ssn: Session, discard: bool = False) -> None:
     """Plugin close hooks + PodGroup status write-back
-    (framework.go:55-63 + session.go:123-148)."""
+    (framework.go:55-63 + session.go:123-148). With ``discard`` (a
+    hard-deadline cycle abort, recovery/budget.py) the write-back is
+    skipped: the aborted cycle's session state is rolled back wholesale
+    — Statement.discard at cycle granularity — leaving the cache/store
+    byte-identical to the cycle's start."""
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)
         metrics.update_plugin_duration(plugin.name, "OnSessionClose", time.perf_counter() - start)
 
-    for job in ssn.jobs.values():
-        if job.pod_group is None:
-            ssn.cache.record_job_status_event(job)
-            continue
-        job.pod_group.status = _job_status(ssn, job)
-        ssn.cache.update_job_status(job)
+    if not discard:
+        for job in ssn.jobs.values():
+            if job.pod_group is None:
+                ssn.cache.record_job_status_event(job)
+                continue
+            job.pod_group.status = _job_status(ssn, job)
+            ssn.cache.update_job_status(job)
 
     ssn.jobs = {}
     ssn.nodes = {}

@@ -236,6 +236,37 @@ cache_mutation_violations = Counter(
     "In-place mutations of shared cached cluster objects detected, by kind",
 )
 
+# -- crash-consistent failover (kube_batch_tpu.recovery) --------------------
+journal_records = Counter(
+    f"{_SUBSYSTEM}_journal_records_total",
+    "Write-intent journal records appended, by state (intent/confirm/append_failed)",
+)
+reconcile_ops = Counter(
+    f"{_SUBSYSTEM}_reconcile_ops_total",
+    "Takeover reconciliation outcomes, by op "
+    "(confirmed/redispatched/conflict/rolled_back/aborted)",
+)
+cycle_overruns = Counter(
+    f"{_SUBSYSTEM}_cycle_overruns_total",
+    "Scheduling cycles past their deadline budget, by kind (soft/hard)",
+)
+resync_dropped = Counter(
+    f"{_SUBSYSTEM}_resync_dropped_total",
+    "errTasks resync entries dropped terminally after exhausting their retry budget",
+)
+stale_cycles_skipped = Counter(
+    f"{_SUBSYSTEM}_stale_cycles_skipped_total",
+    "Scheduling cycles refused because the snapshot exceeded the staleness threshold",
+)
+watch_snapshot_age = Gauge(
+    f"{_SUBSYSTEM}_watch_snapshot_age_seconds",
+    "Seconds since the watch-fed mirror was last known current (oldest kind)",
+)
+watch_relists = Counter(
+    f"{_SUBSYSTEM}_watch_relists_total",
+    "Full re-lists performed by watch clients after 410-Gone, by kind",
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -302,6 +333,36 @@ def register_cache_mutation(kind: str) -> None:
     cache_mutation_violations.inc({"kind": kind})
 
 
+def register_journal_records(state: str, n: int = 1) -> None:
+    journal_records.inc({"state": state}, by=n)
+
+
+def register_reconcile_op(op: str, n: int = 1) -> None:
+    reconcile_ops.inc({"op": op}, by=n)
+
+
+def register_cycle_overrun(kind: str) -> None:
+    cycle_overruns.inc({"kind": kind})
+
+
+def register_resync_drop() -> None:
+    resync_dropped.inc()
+
+
+def register_stale_cycle_skip() -> None:
+    stale_cycles_skipped.inc()
+
+
+def set_watch_snapshot_age(age: float) -> None:
+    # +inf (never synced) renders as 'inf' in exposition, which
+    # Prometheus accepts; clamp anyway to keep dashboards sane
+    watch_snapshot_age.set(min(age, 1e9))
+
+
+def register_watch_relist(kind: str) -> None:
+    watch_relists.inc({"kind": kind})
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -355,6 +416,13 @@ def render_prometheus_text() -> str:
         degraded_cycles,
         write_retries,
         cache_mutation_violations,
+        journal_records,
+        reconcile_ops,
+        cycle_overruns,
+        resync_dropped,
+        stale_cycles_skipped,
+        watch_snapshot_age,
+        watch_relists,
     ]
     lines: list[str] = []
     for metric in families:

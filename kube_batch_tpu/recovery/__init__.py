@@ -1,0 +1,55 @@
+"""Crash-consistent failover for the bind/evict write side.
+
+The reference scheduler gets recovery for free: the Kubernetes
+apiserver is the durable source of truth and informers resync the world
+after a restart (SURVEY §2.2, cache.go:187-300). Our in-process
+ClusterStore + lease elector reproduce *election* but, before this
+package, not *recovery*: a leader killed mid-``bind_many`` left
+assumed-but-unconfirmed binds that the standby neither replayed nor
+reconciled. Omega/Borg-class schedulers treat optimistic transactions
+plus conflict reconciliation as the core robustness mechanism (PAPERS:
+Omega; Borg) — election alone is not an HA story.
+
+The pieces:
+
+- ``journal.WriteIntentJournal`` — an append-before-dispatch,
+  confirm-after-ack write-ahead log wrapped around the cache's async
+  write pool: every bind/evict statement lands in the journal (cycle
+  id, gang id, task→node intent, statement kind) *before* the store
+  write is dispatched, and is confirmed *after* the write acks.
+- ``reconcile.reconcile_journal`` — takeover reconciliation: on lease
+  acquire and on process restart, scan the journal against ClusterStore
+  truth — confirm writes that landed, re-dispatch orphaned intents
+  idempotently, and roll back half-bound gangs (statement-style op log
+  with reverse-order undo) so gang atomicity survives a leader crash
+  mid-bulk-bind.
+- ``budget.CycleBudget`` — the scheduling cycle's deadline budget: a
+  soft deadline arms a solver-ladder tier downgrade, a hard deadline
+  aborts the cycle pre-dispatch (cache byte-identical; the next cycle
+  reschedules) and meters ``cycle.overrun``.
+- ``watch_client.ResilientWatcher`` — bounded-staleness list+watch
+  client: reconnect with jittered exponential backoff, 410-Gone
+  relist-storm coalescing, and a snapshot-age gauge feeding the
+  scheduler's refuse-to-schedule staleness guard.
+- ``fsck`` — offline journal checker
+  (``python -m kube_batch_tpu.recovery.fsck``).
+
+Fault points ``journal.append``, ``journal.replay``, ``reconcile.scan``
+and ``cycle.overrun`` plug into the PR 1 fault registry, so every
+recovery path is drillable in production.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.recovery.budget import CycleBudget, CycleDeadlineExceeded
+from kube_batch_tpu.recovery.journal import WriteIntentJournal
+from kube_batch_tpu.recovery.reconcile import reconcile_journal
+from kube_batch_tpu.recovery.watch_client import ResilientWatcher
+
+__all__ = [
+    "CycleBudget",
+    "CycleDeadlineExceeded",
+    "WriteIntentJournal",
+    "reconcile_journal",
+    "ResilientWatcher",
+]

@@ -1,0 +1,88 @@
+"""Cycle deadline budget: one wedged solve must not stall the loop past
+the lease window.
+
+The failure this closes: ``scheduler.run_once`` had no deadline, so a
+solve that wedged (pathological snapshot, device hang, compile storm)
+stalled the cycle indefinitely — past the lease renew deadline, which
+the elector's watchdog then read as *leader death* and triggered a
+spurious failover of a perfectly healthy process.
+
+Two deadlines, both measured from cycle start:
+
+- **soft** (``KBT_CYCLE_SOFT_DEADLINE_S``): the cycle finishing late is
+  evidence against the solver tier that ran it — the scheduler records
+  a failure against that tier's circuit breaker (faults/ladder.py), so
+  repeated overruns *arm a tier downgrade* through the existing
+  breaker automaton instead of a bespoke mechanism;
+- **hard** (``KBT_CYCLE_HARD_DEADLINE_S``): the cycle aborts. The abort
+  point is always *pre-dispatch* (between actions, between solve
+  segments, and at the dispatch barrier before any ``cache.bind``), so
+  aborting rolls back to a byte-identical cache — the session snapshot
+  is simply discarded, the Statement discipline's ``discard`` at cycle
+  granularity — and the next cycle reschedules the aborted gangs from
+  Pending. Metered as ``cycle.overrun``.
+
+The ``cycle.overrun`` fault point makes a hard overrun injectable: it
+is consulted only at the *dispatch-barrier* check (``inject=True`` —
+the last pre-dispatch gate, after encode+solve+replay have done maximal
+discardable work), so a drill deterministically exercises the
+worst-case abort without a real multi-second stall.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from kube_batch_tpu import faults
+
+
+class CycleDeadlineExceeded(RuntimeError):
+    """Raised at a pre-dispatch check when the hard budget is gone; the
+    scheduler catches it, meters cycle.overrun and discards the cycle."""
+
+
+class CycleBudget:
+    """Deadline state for one scheduling cycle."""
+
+    def __init__(
+        self,
+        soft_s: Optional[float] = None,
+        hard_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.soft_s = soft_s if soft_s and soft_s > 0 else None
+        self.hard_s = hard_s if hard_s and hard_s > 0 else None
+        self._clock = clock
+        self._start = clock()
+
+    def elapsed(self) -> float:
+        return self._clock() - self._start
+
+    def remaining(self) -> float:
+        """Seconds left in the hard budget (inf when no hard deadline):
+        the remaining-budget argument solver entry receives."""
+        if self.hard_s is None:
+            return float("inf")
+        return self.hard_s - self.elapsed()
+
+    def soft_exceeded(self) -> bool:
+        return self.soft_s is not None and self.elapsed() > self.soft_s
+
+    def hard_exceeded(self, inject: bool = False) -> bool:
+        """True when the hard deadline passed — or, at the dispatch
+        barrier (``inject=True``), when the ``cycle.overrun`` fault
+        point fires (an injected wedged-solve drill)."""
+        if inject and faults.should_fire("cycle.overrun"):
+            return True
+        return self.hard_s is not None and self.elapsed() > self.hard_s
+
+    def check(self, where: str, inject: bool = False) -> None:
+        """Raise CycleDeadlineExceeded when the hard budget is gone.
+        Call sites are all pre-dispatch (see module docstring)."""
+        if self.hard_exceeded(inject=inject):
+            raise CycleDeadlineExceeded(
+                f"cycle hard deadline exceeded at {where} "
+                f"({self.elapsed():.3f}s elapsed, budget "
+                f"{self.hard_s if self.hard_s is not None else 'injected'})"
+            )

@@ -36,6 +36,19 @@ from kube_batch_tpu.conf import (
 )
 from kube_batch_tpu.faults import mutation_detector
 from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.recovery.budget import CycleBudget, CycleDeadlineExceeded
+
+
+def _env_float(name: str, default: float) -> float:
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        log.errorf(
+            "%s=%r is not a number; using %g", name, os.environ.get(name), default
+        )
+        return default
 
 DEFAULT_SCHEDULER_CONF = """
 actions: "enqueue, allocate, backfill"
@@ -74,6 +87,20 @@ class Scheduler:
         self.plugins = []
         self.action_arguments: dict[str, dict[str, str]] = {}
         self._conf_cache: Optional[str] = None
+        # Cycle deadline budget (recovery/budget.py): soft overruns arm
+        # a solver-tier downgrade through the ladder breakers; a hard
+        # overrun aborts the cycle pre-dispatch. 0/unset = no deadline.
+        self._soft_deadline = _env_float("KBT_CYCLE_SOFT_DEADLINE_S", 0.0) or None
+        self._hard_deadline = _env_float("KBT_CYCLE_HARD_DEADLINE_S", 0.0) or None
+        # Bounded-staleness guard: refuse to schedule over a snapshot
+        # older than this (watch-fed caches report real age; the
+        # in-process store reports 0). 0 = guard off.
+        self._max_snapshot_age = _env_float("KBT_MAX_SNAPSHOT_AGE_S", 0.0)
+        # Consecutive soft overruns — tracked here, NOT via breaker
+        # record_failure: a slow-but-successful solve records a breaker
+        # success every cycle, which would reset per-call failures and
+        # make the downgrade unreachable.
+        self._soft_overruns = 0
         self._load_conf()
 
     def _load_conf(self) -> None:
@@ -129,6 +156,27 @@ class Scheduler:
         cycle_start = time.perf_counter()
         self._load_conf()
 
+        # Bounded-staleness guard: scheduling over a stale mirror binds
+        # pods onto nodes that may no longer exist — refuse the cycle
+        # and let the watch client catch up (the k8s contract is the
+        # same: a scheduler partitioned from the apiserver stops).
+        if self._max_snapshot_age > 0:
+            age_fn = getattr(self.cache, "snapshot_age", None)
+            age = age_fn() if age_fn is not None else 0.0
+            if age > self._max_snapshot_age:
+                metrics.register_stale_cycle_skip()
+                log.errorf(
+                    "snapshot is %.1fs stale (threshold %.1fs); refusing to "
+                    "schedule this cycle", age, self._max_snapshot_age,
+                )
+                return
+
+        # Cycle id for the write-intent journal (recovery/journal.py):
+        # every bind/evict this cycle dispatches carries it, so a
+        # takeover can group in-flight intents by statement.
+        if hasattr(self.cache, "cycle"):
+            self.cache.cycle += 1
+
         # Cache-mutation detector (VERDICT row 58): when enabled (tier-1
         # runs set KBT_CACHE_MUTATION_DETECTOR), digest the store's
         # objects before plugin+action execution and verify after — any
@@ -140,18 +188,86 @@ class Scheduler:
                 detector = mutation_detector.MutationDetector(store)
                 detector.snapshot()
 
+        budget = CycleBudget(self._soft_deadline, self._hard_deadline)
         ssn = open_session(self.cache, self.plugins, self.action_arguments)
+        # Actions read the budget off the session (xla_allocate threads
+        # the remaining budget into its solver entry and checks it at
+        # every pre-dispatch boundary).
+        ssn.cycle_budget = budget
+        aborted: Optional[CycleDeadlineExceeded] = None
         try:
             for action in self.actions:
-                action_start = time.perf_counter()
-                action.execute(ssn)
-                metrics.update_action_duration(
-                    action.name, time.perf_counter() - action_start
-                )
+                try:
+                    action_start = time.perf_counter()
+                    action.execute(ssn)
+                    metrics.update_action_duration(
+                        action.name, time.perf_counter() - action_start
+                    )
+                    # post-action gate: a cycle already past its hard
+                    # budget must not start the next action
+                    budget.check(f"after action {action.name}")
+                except CycleDeadlineExceeded as e:
+                    aborted = e
+                    break
         finally:
-            close_session(ssn)
+            # discard on abort: skip the status write-back so the
+            # store stays byte-identical to the cycle's start (every
+            # abort point is pre-dispatch)
+            close_session(ssn, discard=aborted is not None)
             metrics.update_e2e_duration(time.perf_counter() - cycle_start)
             metrics.schedule_attempts.inc()
             log.V(4).infof("End scheduling ...")
+        if aborted is not None:
+            metrics.register_cycle_overrun("hard")
+            log.errorf(
+                "scheduling cycle aborted: %s (session discarded; pending "
+                "gangs reschedule next cycle)", aborted,
+            )
+        elif budget.soft_exceeded():
+            self._arm_tier_downgrade(budget)
+        else:
+            self._soft_overruns = 0  # a within-budget cycle clears the streak
         if detector is not None:
             detector.verify()  # raises CacheMutationError on violation
+
+    def _arm_tier_downgrade(self, budget: CycleBudget) -> None:
+        """Soft overrun: consecutive slow cycles trip the breaker of the
+        tier that ran them (faults/ladder.py), routing the next cycles
+        one rung down — instead of the cycle stalling until the lease
+        watchdog calls a healthy leader dead. The streak is counted
+        here (see __init__) and the trip reuses the breaker automaton:
+        open -> backoff -> half-open probe -> close."""
+        metrics.register_cycle_overrun("soft")
+        self._soft_overruns += 1
+        tier = next(
+            (
+                getattr(a, "last_solver_tier", None)
+                for a in self.actions
+                if getattr(a, "last_solver_tier", None) not in (None, "none")
+            ),
+            None,
+        )
+        if tier == "sharded_xla":
+            tier = "xla"  # the sharded rung shares the xla breaker
+        ladder = faults.solver_ladder
+        breaker = ladder.breakers.get(tier)
+        if breaker is None:
+            log.warningf(
+                "cycle exceeded soft deadline (%.2fs > %.2fs) on tier %s "
+                "(no breaker to arm)", budget.elapsed(), budget.soft_s, tier,
+            )
+            return
+        if self._soft_overruns >= breaker.failure_threshold:
+            ladder.trip(tier)
+            self._soft_overruns = 0
+            log.warningf(
+                "cycle exceeded soft deadline (%.2fs > %.2fs) repeatedly; "
+                "tripped solver tier %s (ladder downgrades until the "
+                "recovery probe closes it)", budget.elapsed(), budget.soft_s, tier,
+            )
+        else:
+            log.warningf(
+                "cycle exceeded soft deadline (%.2fs > %.2fs) on tier %s "
+                "(downgrade trips after %d consecutive overruns)",
+                budget.elapsed(), budget.soft_s, tier, breaker.failure_threshold,
+            )

@@ -140,18 +140,23 @@ class WatchHub:
     SharedInformerFactory against the API server
     (pkg/client/informers/externalversions/factory.go); in-process, the
     hub subscribes one handler per kind and keeps a bounded ring of
-    events. `since` is the resourceVersion returned by list/watch
-    replies; a client that falls behind the ring gets `gone` and must
+    events **per kind**: one slow watcher of a churning kind can only
+    ever hold MAX_EVENTS of that kind's events — it cannot grow the
+    buffer without limit, and it cannot evict a quiet kind's events.
+    `since` is the resourceVersion returned by list/watch replies; a
+    client that falls behind its kind's ring gets `gone` and must
     re-list, exactly the k8s 410-Gone contract."""
 
-    MAX_EVENTS = 8192
+    MAX_EVENTS = 8192  # ring capacity PER KIND
 
-    def __init__(self, store: ClusterStore) -> None:
+    def __init__(self, store: ClusterStore, max_events: Optional[int] = None) -> None:
         self._cond = threading.Condition()
-        self._events: deque = deque()  # (seq, kind, verb, body), seq-ascending
+        self.max_events = max_events or self.MAX_EVENTS
+        # kind -> ring of (seq, verb, body), seq-ascending
+        self._events: dict[str, deque] = {k: deque() for k in KINDS}
         self._seq = 0
         # Newest dropped seq per kind: Gone fires only when events of the
-        # *requested* kind actually fell out of the ring, so a watcher of
+        # *requested* kind actually fell out of its ring, so a watcher of
         # a quiet kind is not forced to re-list because pods churned.
         self._dropped: dict[str, int] = {}
         self._closed = False
@@ -184,10 +189,13 @@ class WatchHub:
         body = SERIALIZERS[kind](obj)
         with self._cond:
             self._seq += 1
-            if len(self._events) >= self.MAX_EVENTS:
-                seq, k, _, _ = self._events.popleft()
-                self._dropped[k] = seq
-            self._events.append((self._seq, kind, verb, body))
+            ring = self._events[kind]
+            if len(ring) >= self.max_events:
+                # true 410 on overflow: the dropped seq fences every
+                # watcher holding an rv at or before it into a re-list
+                seq, _, _ = ring.popleft()
+                self._dropped[kind] = seq
+            ring.append((self._seq, verb, body))
             self._cond.notify_all()
 
     def close(self) -> None:
@@ -226,11 +234,10 @@ class WatchHub:
                 # Ring entries are seq-ascending: walk from the right only
                 # as far as `since` — O(new events), not O(ring).
                 batch: list[dict] = []
-                for seq, k, verb, body in reversed(self._events):
+                for seq, verb, body in reversed(self._events[kind]):
                     if seq <= since:
                         break
-                    if k == kind:
-                        batch.append({"seq": seq, "type": verb, "object": body})
+                    batch.append({"seq": seq, "type": verb, "object": body})
                 if batch:
                     batch.reverse()
                     return "ok", batch, self._seq
@@ -908,11 +915,24 @@ class SchedulerServer:
         default_queue: str = DEFAULT_QUEUE,
         listen_address: str = DEFAULT_LISTEN_ADDRESS,
         store: Optional[ClusterStore] = None,
+        journal_path: Optional[str] = None,
     ) -> None:
+        import os
+
         self.store = store or ClusterStore()
         self.watch_hub = WatchHub(self.store)
+        # Crash-consistent write side (recovery/): --journal / KBT_JOURNAL
+        # attaches a bind-intent WAL to the cache; start() reconciles it
+        # against store truth before the loop runs.
+        self.journal = None
+        journal_path = journal_path or os.environ.get("KBT_JOURNAL", "").strip()
+        if journal_path:
+            from kube_batch_tpu.recovery import WriteIntentJournal
+
+            self.journal = WriteIntentJournal(journal_path)
         self.cache = SchedulerCache(
-            self.store, scheduler_name=scheduler_name, default_queue=default_queue
+            self.store, scheduler_name=scheduler_name, default_queue=default_queue,
+            journal=self.journal,
         )
         self.scheduler = Scheduler(
             self.cache, scheduler_conf=scheduler_conf, schedule_period=schedule_period
@@ -932,6 +952,18 @@ class SchedulerServer:
     def listen_port(self) -> int:
         return self.httpd.server_address[1]
 
+    def reconcile(self):
+        """Takeover reconciliation (recovery/reconcile.py): scan the
+        bind-intent journal against store truth — confirm landed writes,
+        re-dispatch orphans, roll back half-bound gangs. Runs before the
+        loop on every start (process restart AND lease takeover both
+        pass through here: a leader only start()s after acquiring)."""
+        if self.journal is None:
+            return None
+        from kube_batch_tpu.recovery import reconcile_journal
+
+        return reconcile_journal(self.journal, self.store)
+
     def start(self) -> None:
         # Ensure the default queue exists (the reference expects an admin
         # to create it; the in-process store bootstraps it).
@@ -939,6 +971,7 @@ class SchedulerServer:
             self.store.create_queue(
                 Queue(metadata=ObjectMeta(name=self.cache.default_queue))
             )
+        self.reconcile()
         self._stop.clear()
         t_http = threading.Thread(
             target=self.httpd.serve_forever, name="kb-http", daemon=True
@@ -959,6 +992,8 @@ class SchedulerServer:
         for t in self._threads:
             t.join(timeout=10)
         self._threads.clear()
+        if self.journal is not None:
+            self.journal.close()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1016,6 +1051,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="lease object name under the arbiter (reference lock object "
         "name, server.go:117)",
     )
+    p.add_argument(
+        "--journal",
+        default="",
+        help="bind-intent journal (WAL) path for crash-consistent "
+        "failover; reconciled against store truth on startup/takeover "
+        "(env KBT_JOURNAL; empty = journaling off)",
+    )
     p.add_argument("--version", action="store_true", help="show version and quit")
     p.add_argument("-v", type=int, default=0, help="log verbosity (glog -v)")
     return p
@@ -1066,7 +1108,11 @@ def run(argv: Optional[list[str]] = None) -> None:
         schedule_period=opt.schedule_period,
         default_queue=opt.default_queue,
         listen_address=opt.listen_address,
+        journal_path=opt.journal or None,
     )
+    # start() reconciles the journal before the loop: both the restart
+    # and the lease-takeover path land here only once leadership (if
+    # any) is held, so reconciliation always runs under the lease.
     server.start()
     log.infof(
         "kube-batch-tpu %s serving on :%d, scheduling every %.2fs",

@@ -114,6 +114,13 @@ class RateLimitingQueue:
         with self._cond:
             self._failures.pop(self._key(item), None)
 
+    def failures(self, item: Any) -> int:
+        """Rate-limited adds recorded for this item since the last
+        forget (client-go's NumRequeues) — what a caller's terminal-drop
+        budget compares against."""
+        with self._cond:
+            return self._failures.get(self._key(item), 0)
+
     def __len__(self) -> int:
         with self._cond:
             return len(self._pending) + len(self._processing)

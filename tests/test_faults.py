@@ -244,6 +244,53 @@ def test_watch_drop_client_recovers_via_relist():
         srv.stop()
 
 
+def test_410_relist_storm_converges_and_staleness_gauge_recovers():
+    """Satellite (ISSUE 3): repeated watch.drop firings under churn — a
+    ResilientWatcher rides the storm via coalesced re-lists; once the
+    drops stop, the mirror converges to store truth and the snapshot-age
+    gauge returns to ~0."""
+    from kube_batch_tpu.recovery import ResilientWatcher
+
+    srv = SchedulerServer(listen_address="127.0.0.1:0", schedule_period=5.0)
+    srv.start()
+    w = ResilientWatcher(
+        f"http://127.0.0.1:{srv.listen_port}", ("queues",),
+        poll_timeout=0.3, min_backoff=0.01, relist_min_interval=0.05,
+    )
+    try:
+        w.start()
+        wait_until(
+            lambda: "default" in w.mirror["queues"], what="initial list lands"
+        )
+        relists_before = metrics.watch_relists.value({"kind": "queues"})
+        # the storm: half of all watch polls drop while queues churn
+        faults.registry.arm("watch.drop", probability=0.5, seed=11)
+        for i in range(12):
+            srv.store.create_queue(build_queue(f"storm{i}", weight=1 + i % 3))
+            time.sleep(0.02)
+        for i in range(0, 12, 2):
+            srv.store.delete_queue(f"storm{i}")
+        time.sleep(0.3)  # let several drops fire mid-churn
+        faults.registry.reset()
+        truth = {q.name for q in srv.store.list("queues")}
+        wait_until(
+            lambda: set(w.mirror["queues"]) == truth,
+            what="mirror converges to store truth after the storm",
+        )
+        wait_until(
+            lambda: w.snapshot_age() < 1.0,
+            what="staleness gauge returns to ~0",
+        )
+        assert metrics.fault_injections.value({"point": "watch.drop"}) >= 1
+        # recovery went through the re-list path, and the gauge metric
+        # reflects the healthy age
+        assert metrics.watch_relists.value({"kind": "queues"}) >= relists_before + 1
+        assert metrics.watch_snapshot_age.value() < 1.0
+    finally:
+        w.stop()
+        srv.stop()
+
+
 # -- 5. lease elector --------------------------------------------------------
 
 

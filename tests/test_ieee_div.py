@@ -50,8 +50,10 @@ def test_f64_division_bit_exact_on_cpu_backend():
     rng = np.random.default_rng(1)
     x = rng.uniform(1e-3, 1e12, 100_000)
     y = rng.uniform(1e-3, 1e12, 100_000)
+    from jax.experimental import enable_x64
+
     with jax.default_device(cpu):
-        with jax.enable_x64(True):
+        with enable_x64():
             got = np.asarray(jax.jit(ieee_div)(x, y))
     np.testing.assert_array_equal(got, x / y)
 

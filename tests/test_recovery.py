@@ -1,0 +1,642 @@
+"""Crash-consistent failover (ISSUE 3 tentpole): the bind-intent
+journal, takeover reconciliation, the cycle deadline budget, and the
+bounded-staleness watch hardening — driven end to end.
+
+The headline chaos e2e kills a leader mid-``bind_many`` (the write pool
+dies between the journal's append-before-dispatch and the store writes)
+and asserts the standby's reconciled final placements are bind-for-bind
+equal to an uninterrupted run: zero lost binds, zero duplicate binds,
+cache-mutation detector on throughout (conftest arms it suite-wide).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from kube_batch_tpu import faults, metrics
+from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.cache.cache import StoreBinder
+from kube_batch_tpu.faults.mutation_detector import MutationDetector
+from kube_batch_tpu.cache.store import EventHandler
+from kube_batch_tpu.recovery import (
+    CycleBudget,
+    CycleDeadlineExceeded,
+    WriteIntentJournal,
+    reconcile_journal,
+)
+from kube_batch_tpu.recovery.fsck import fsck, main as fsck_main
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.server import SchedulerServer, WatchHub
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+    yield
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+
+
+def wait_until(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+XLA_CONF = """
+actions: "enqueue, xla_allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def seed_store(store: ClusterStore, gangs: int = 2, members: int = 6) -> None:
+    """gangs x members pending gang pods on 4 nodes."""
+    store.create_queue(build_queue("default"))
+    for i in range(4):
+        store.create_node(
+            build_node(f"n{i}", build_resource_list(cpu=16, memory="16Gi", pods=32))
+        )
+    for g in range(gangs):
+        store.create_pod_group(build_pod_group(f"g{g}", min_member=members))
+        for m in range(members):
+            store.create_pod(
+                build_pod(
+                    name=f"g{g}-p{m}", group_name=f"g{g}",
+                    req=build_resource_list(cpu=1, memory="512Mi"),
+                )
+            )
+
+
+def make_scheduler(store, tmp_path, journal=None, binder=None):
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(XLA_CONF)
+    cache = SchedulerCache(store, journal=journal, binder=binder)
+    return cache, Scheduler(cache, scheduler_conf=str(conf), schedule_period=0.05)
+
+
+def placements(store) -> dict:
+    return {f"{p.namespace}/{p.name}": p.node_name for p in store.list("pods")}
+
+
+# -- journal unit ------------------------------------------------------------
+
+
+def test_journal_append_confirm_outstanding_roundtrip(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    seqs = j.append_intents(
+        "bind", [("g", "default/a", "n0"), ("g", "default/b", "n1")], cycle=7
+    )
+    j.append_intents("evict", [("h", "default/c", "")], cycle=7)
+    j.confirm(seqs[0])
+    j.confirm(seqs[0])  # idempotent
+    out = j.outstanding()
+    assert [(i.op, i.pod) for i in out] == [
+        ("bind", "default/b"), ("evict", "default/c"),
+    ]
+    assert all(i.cycle == 7 for i in out)
+    # a fresh handle on the same file sees the same truth (crash replay)
+    replay = WriteIntentJournal.replay(path)
+    assert len(replay.intents) == 3 and len(replay.confirmed) == 1
+    assert [i.pod for i in replay.orphans] == ["default/b", "default/c"]
+    j.close()
+
+
+def test_journal_survives_torn_tail_and_compacts(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    seqs = j.append_intents("bind", [("g", "default/a", "n0"), ("g", "default/b", "n0")])
+    j.confirm(seqs[0])
+    j.close()
+    with open(path, "a") as fh:
+        fh.write('{"rec":"intent","seq":99,"cyc')  # crash mid-append
+    replay = WriteIntentJournal.replay(path)
+    assert replay.corrupt == 1
+    assert [i.seq for i in replay.orphans] == [seqs[1]]
+    # reopening resumes seq numbering past everything seen and compaction
+    # drops confirmed history + the torn tail
+    j2 = WriteIntentJournal(path)
+    j2.compact()
+    replay2 = WriteIntentJournal.replay(path)
+    assert replay2.corrupt == 0
+    assert set(replay2.intents) == {seqs[1]}
+    new = j2.append_intents("bind", [("g", "default/c", "n1")])
+    assert new[0] > seqs[1]
+    j2.close()
+
+
+def test_fsck_reports_orphans_and_strict_gates(tmp_path, capsys):
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    seqs = j.append_intents(
+        "bind", [("default/g0", "default/p0", "n0"), ("default/g0", "default/p1", "n1")]
+    )
+    j.confirm(seqs[0])
+    j.close()
+    summary = fsck(path)
+    assert summary["intents"] == 2 and summary["confirmed"] == 1
+    assert summary["orphaned"] == 1
+    assert summary["orphaned_gangs"] == {"cycle=0 gang=default/g0": 1}
+    # CLI: rc 0 with orphans (normal after a crash), rc 1 under --strict
+    assert fsck_main([path]) == 0
+    assert fsck_main(["--strict", path]) == 1
+    assert fsck_main(["--json", path]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["orphaned"] == 1
+    assert fsck_main([str(tmp_path / "missing.wal")]) == 0  # empty journal is clean
+
+
+# -- reconciliation ----------------------------------------------------------
+
+
+def test_reconcile_confirms_landed_redispatches_orphans(tmp_path):
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=3)
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    j.append_intents(
+        "bind",
+        [
+            ("default/g0", "default/g0-p0", "n0"),  # will land
+            ("default/g0", "default/g0-p1", "n1"),  # orphaned
+            ("default/g0", "default/g0-p2", "n2"),  # orphaned
+        ],
+        cycle=1,
+    )
+    # the dead leader's write pool completed only the first write
+    import dataclasses
+
+    p0 = store.get_pod("default", "g0-p0")
+    store.update_pod(dataclasses.replace(p0, node_name="n0"))
+
+    det = MutationDetector(store)
+    det.snapshot()
+    report = reconcile_journal(j, store)
+    assert det.violations() == []  # reconciliation replaces, never mutates
+    assert report.confirmed == 1 and report.redispatched == 2
+    assert report.rolled_back == 0 and not report.aborted
+    assert placements(store) == {
+        "default/g0-p0": "n0", "default/g0-p1": "n1", "default/g0-p2": "n2",
+    }
+    # journal is clean afterwards: nothing for the next takeover
+    assert j.outstanding() == []
+    assert fsck(path)["orphaned"] == 0
+    j.close()
+
+
+def test_reconcile_rolls_back_half_bound_gang_when_member_unfixable(tmp_path):
+    """Gang atomicity: a member pod vanished while the leader was down —
+    the gang cannot reach min_member, so its landed and re-dispatched
+    binds are rolled back (statement-style reverse undo)."""
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=3)
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    j.append_intents(
+        "bind",
+        [
+            ("default/g0", "default/g0-p0", "n0"),  # landed before the crash
+            ("default/g0", "default/g0-p1", "n1"),  # orphaned, fixable
+            ("default/g0", "default/g0-p2", "n2"),  # orphaned, pod deleted
+        ],
+        cycle=1,
+    )
+    import dataclasses
+
+    p0 = store.get_pod("default", "g0-p0")
+    store.update_pod(dataclasses.replace(p0, node_name="n0"))
+    store.delete_pod("default", "g0-p2")
+
+    report = reconcile_journal(j, store)
+    assert report.gangs_rolled_back == ["default/g0"]
+    assert report.rolled_back >= 1
+    # every surviving member is back to Pending/unbound: the gang will
+    # be rescheduled whole (or not at all) by the next leader's cycle
+    assert placements(store) == {"default/g0-p0": "", "default/g0-p1": ""}
+    assert j.outstanding() == []
+    j.close()
+
+
+def test_reconcile_respects_store_truth_on_conflict(tmp_path):
+    """A pod bound elsewhere while the leader was down is left alone —
+    store truth wins (the Omega conflict rule)."""
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=2)
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    j.append_intents(
+        "bind",
+        [
+            ("default/g0", "default/g0-p0", "n0"),
+            ("default/g0", "default/g0-p1", "n1"),
+        ],
+    )
+    import dataclasses
+
+    p0 = store.get_pod("default", "g0-p0")
+    store.update_pod(dataclasses.replace(p0, node_name="n3"))  # rival bound it
+
+    report = reconcile_journal(j, store)
+    assert report.conflicts == 1 and report.redispatched == 1
+    assert placements(store) == {"default/g0-p0": "n3", "default/g0-p1": "n1"}
+    j.close()
+
+
+def test_reconcile_degrades_on_journal_replay_and_scan_faults(tmp_path):
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=2)
+    path = str(tmp_path / "j.wal")
+    j = WriteIntentJournal(path)
+    j.append_intents("bind", [("default/g0", "default/g0-p0", "n0")])
+    before = placements(store)
+
+    faults.registry.arm("journal.replay", count=1)
+    report = reconcile_journal(j, store)
+    assert report.aborted and placements(store) == before
+
+    faults.registry.reset()
+    faults.registry.arm("reconcile.scan", count=1)
+    report = reconcile_journal(j, store)
+    assert report.aborted and placements(store) == before
+
+    # fault cleared: the next takeover completes the work
+    faults.registry.reset()
+    report = reconcile_journal(j, store)
+    assert report.redispatched == 1
+    assert placements(store)["default/g0-p0"] == "n0"
+    j.close()
+
+
+# -- the chaos e2e: leader dies mid-bulk-bind --------------------------------
+
+
+class _LeaderKilled(BaseException):
+    """SIGKILL stand-in: BaseException so neither the write-retry ladder
+    nor the resync routing (both catch Exception) can 'survive' it —
+    the write pool dies exactly where a killed process would."""
+
+
+class DyingBinder(StoreBinder):
+    """Store binder that dies after N successful writes (mid-batch)."""
+
+    def __init__(self, store, die_after: int) -> None:
+        super().__init__(store)
+        self.left = die_after
+
+    def bind(self, pod, hostname: str) -> None:
+        if self.left <= 0:
+            raise _LeaderKilled()
+        self.left -= 1
+        super().bind(pod, hostname)
+
+
+def _count_bind_events(store) -> dict:
+    """pod key -> number of unbound->bound transitions (duplicate-bind
+    detector for the acceptance criterion)."""
+    counts: dict[str, int] = {}
+
+    def on_update(old, new):
+        if not old.node_name and new.node_name:
+            key = f"{new.namespace}/{new.name}"
+            counts[key] = counts.get(key, 0) + 1
+
+    store.add_event_handler("pods", EventHandler(on_update=on_update))
+    return counts
+
+
+def test_chaos_leader_killed_mid_bulk_bind_standby_reconciles(tmp_path):
+    """THE acceptance e2e: SIGKILL the leader mid-`bind_many` (after the
+    journal appended the whole statement, after some store writes
+    landed); the standby reconciles on takeover; final placements are
+    bind-for-bind equal to an uninterrupted run — zero lost, zero
+    duplicate binds; mutation detector armed (conftest) for the leader
+    cycle and explicitly around reconciliation."""
+    # uninterrupted twin: same seed, run to completion
+    twin = ClusterStore()
+    seed_store(twin)
+    _, sched_t = make_scheduler(twin, tmp_path)
+    sched_t.run_once()
+    expected = placements(twin)
+    assert all(expected.values()) and len(expected) == 12
+
+    # the real run: leader journaled, killed after 4 of 12 bulk writes
+    store = ClusterStore()
+    seed_store(store)
+    bind_counts = _count_bind_events(store)
+    journal = WriteIntentJournal(str(tmp_path / "leader.wal"))
+    _, sched = make_scheduler(
+        store, tmp_path, journal=journal, binder=DyingBinder(store, die_after=4)
+    )
+    with pytest.raises(_LeaderKilled):
+        sched.run_once()
+    landed = {k: v for k, v in placements(store).items() if v}
+    assert 0 < len(landed) < 12, "kill must land mid-batch"
+    orphans = WriteIntentJournal.replay(journal.path).orphans
+    assert len(orphans) == 12 - len(landed), "journal must hold the in-flight suffix"
+
+    # standby takeover: fresh process (new journal handle, fresh cache
+    # built from store truth), reconcile before its loop runs
+    standby_journal = WriteIntentJournal(str(tmp_path / "leader.wal"))
+    det = MutationDetector(store)
+    det.snapshot()
+    report = reconcile_journal(standby_journal, store)
+    assert det.violations() == []
+    assert report.redispatched == 12 - len(landed)
+    assert report.rolled_back == 0
+
+    final = placements(store)
+    assert final == expected, "reconciled placements must equal the uninterrupted run"
+    assert all(n == 1 for n in bind_counts.values()), f"duplicate binds: {bind_counts}"
+    assert set(bind_counts) == set(expected), "lost binds"
+
+    # the standby's own scheduling loop finds a fully-bound world: a
+    # second cycle must not move or re-bind anything
+    cache_b, sched_b = make_scheduler(store, tmp_path)
+    sched_b.run_once()
+    assert placements(store) == expected
+    assert all(n == 1 for n in bind_counts.values())
+    standby_journal.close()
+
+
+def test_server_start_reconciles_seeded_journal(tmp_path):
+    """The server-level wiring: a SchedulerServer handed a journal with
+    orphaned intents (the dead leader's) re-drives them at start(),
+    before its loop schedules anything."""
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=4)
+    path = str(tmp_path / "leader.wal")
+    j = WriteIntentJournal(path)
+    j.append_intents(
+        "bind",
+        [("default/g0", f"default/g0-p{m}", f"n{m % 4}") for m in range(4)],
+        cycle=9,
+    )
+    j.close()
+    srv = SchedulerServer(
+        listen_address="127.0.0.1:0", schedule_period=0.05,
+        store=store, journal_path=path,
+    )
+    srv.start()
+    try:
+        wait_until(
+            lambda: all(p.node_name for p in store.list("pods")),
+            what="journal orphans re-dispatched at takeover",
+        )
+        assert placements(store) == {
+            f"default/g0-p{m}": f"n{m % 4}" for m in range(4)
+        }
+    finally:
+        srv.stop()
+
+
+def test_journal_append_fault_degrades_to_unjournaled_dispatch(tmp_path):
+    """journal.append: a WAL I/O failure must not brick the write side —
+    the batch dispatches unjournaled, loudly metered, and binds land."""
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=4)
+    journal = WriteIntentJournal(str(tmp_path / "j.wal"))
+    _, sched = make_scheduler(store, tmp_path, journal=journal)
+    before = metrics.journal_records.value({"state": "append_failed"})
+    faults.registry.arm("journal.append")
+    sched.run_once()
+    assert all(placements(store).values()), "binds lost under journal failure"
+    assert metrics.journal_records.value({"state": "append_failed"}) > before
+    assert journal.outstanding() == []  # nothing journaled, nothing orphaned
+    journal.close()
+
+
+# -- cycle deadline budget ---------------------------------------------------
+
+
+def test_budget_soft_and_hard_semantics():
+    now = [0.0]
+    b = CycleBudget(soft_s=1.0, hard_s=2.0, clock=lambda: now[0])
+    assert not b.soft_exceeded() and not b.hard_exceeded()
+    assert b.remaining() == 2.0
+    now[0] = 1.5
+    assert b.soft_exceeded() and not b.hard_exceeded()
+    now[0] = 2.5
+    assert b.hard_exceeded()
+    with pytest.raises(CycleDeadlineExceeded, match="dispatch"):
+        b.check("dispatch barrier")
+    # no deadlines configured: never exceeded, infinite budget
+    b2 = CycleBudget()
+    assert b2.remaining() == float("inf") and not b2.hard_exceeded()
+
+
+def test_hard_deadline_abort_leaves_cache_byte_identical_then_reschedules(tmp_path):
+    """Satellite regression: the cycle.overrun drill fires at the
+    dispatch barrier (after encode+solve+replay) — the abort discards
+    the session wholesale, the store is BYTE-identical (same objects,
+    mutation detector armed via conftest), and the next cycle
+    reschedules the aborted gangs."""
+    store = ClusterStore()
+    seed_store(store)
+    before_objs = {f"{p.namespace}/{p.name}": p for p in store.list("pods")}
+    before_pgs = list(store.list("podgroups"))
+    _, sched = make_scheduler(store, tmp_path)
+
+    h_before = metrics.cycle_overruns.value({"kind": "hard"})
+    faults.registry.arm("cycle.overrun", count=1)
+    sched.run_once()  # aborts pre-dispatch; detector verifies inside
+    assert metrics.cycle_overruns.value({"kind": "hard"}) == h_before + 1
+    after_objs = {f"{p.namespace}/{p.name}": p for p in store.list("pods")}
+    assert set(after_objs) == set(before_objs)
+    for key, pod in after_objs.items():
+        assert pod is before_objs[key], f"{key} was written during an aborted cycle"
+    for pg_before, pg_after in zip(before_pgs, store.list("podgroups")):
+        assert pg_after is pg_before, "podgroup status written during an aborted cycle"
+
+    # fault consumed (count=1): the next cycle binds everything
+    sched.run_once()
+    final = placements(store)
+    assert all(final.values()) and len(final) == 12
+
+
+def test_soft_overrun_arms_ladder_downgrade(tmp_path, monkeypatch):
+    """A cycle past its soft deadline records a failure against the tier
+    that ran it; at the breaker threshold the ladder downgrades."""
+    monkeypatch.setenv("KBT_CYCLE_SOFT_DEADLINE_S", "0.000001")
+    from kube_batch_tpu.faults.ladder import OPEN, DegradationLadder
+
+    ladder = DegradationLadder(
+        ("mesh_pallas", "pallas", "xla", "serial"),
+        failure_threshold=2, reset_timeout=30.0,
+    )
+    monkeypatch.setattr(faults, "solver_ladder", ladder)
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=2)
+    _, sched = make_scheduler(store, tmp_path)
+    s_before = metrics.cycle_overruns.value({"kind": "soft"})
+    sched.run_once()  # any real cycle exceeds a 1us soft deadline
+    assert metrics.cycle_overruns.value({"kind": "soft"}) == s_before + 1
+    assert ladder.state("xla") == "closed"  # one overrun: streak armed only
+    # drain + re-pend: second slow cycle trips the threshold
+    for p in store.list("pods"):
+        store.delete_pod(p.namespace, p.name)
+    for m in range(2):
+        store.create_pod(
+            build_pod(
+                name=f"r-p{m}", group_name="g0",
+                req=build_resource_list(cpu=1, memory="512Mi"),
+            )
+        )
+    sched.run_once()
+    assert ladder.state("xla") == OPEN, "repeated soft overruns must arm the downgrade"
+
+
+# -- bounded staleness -------------------------------------------------------
+
+
+def test_staleness_guard_refuses_to_schedule(tmp_path, monkeypatch):
+    monkeypatch.setenv("KBT_MAX_SNAPSHOT_AGE_S", "5")
+    store = ClusterStore()
+    seed_store(store, gangs=1, members=2)
+    age = [999.0]
+    cache = SchedulerCache(store, staleness_fn=lambda: age[0])
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(XLA_CONF)
+    sched = Scheduler(cache, scheduler_conf=str(conf), schedule_period=0.05)
+    before = metrics.stale_cycles_skipped.value()
+    sched.run_once()
+    assert metrics.stale_cycles_skipped.value() == before + 1
+    assert not any(placements(store).values()), "scheduled over a stale snapshot"
+    age[0] = 0.0  # watch caught up
+    sched.run_once()
+    assert all(placements(store).values())
+
+
+def test_watchhub_per_kind_ring_overflow_gone_and_isolation():
+    """Satellite: the per-kind ring bounds a slow watcher's buffer with
+    true 410 on overflow, churn in one kind cannot evict another kind's
+    events, and the documented contract (re-list, resume) converges."""
+    store = ClusterStore()
+    hub = WatchHub(store, max_events=8)
+    import threading
+
+    stop = threading.Event()
+    rv0 = hub.resource_version
+    store.create_node(build_node("n-keep", build_resource_list(cpu=1)))
+    # churn queues far past the ring capacity
+    for i in range(32):
+        store.create_queue(build_queue(f"q{i}"))
+        store.delete_queue(f"q{i}")
+    # the queue watcher fell out of its ring: true 410
+    status, _, rv = hub.poll("queues", rv0, 0, stop)
+    assert status == "gone"
+    # the node watcher is untouched by queue churn: its event survives
+    status, events, _ = hub.poll("nodes", rv0, 0, stop)
+    assert status == "ok"
+    assert [e["object"]["name"] for e in events] == ["n-keep"]
+    # the contract: re-list, resume from the fresh rv, convergence
+    listed = {q.name for q in store.list("queues")}
+    rv = hub.resource_version
+    assert listed == set()
+    store.create_queue(build_queue("after-relist"))
+    status, events, rv = hub.poll("queues", rv, 0, stop)
+    assert status == "ok"
+    assert [e["object"]["name"] for e in events] == ["after-relist"]
+
+
+def test_resilient_watcher_converges_and_reports_age():
+    """ResilientWatcher against a live server: initial list + watch
+    convergence, snapshot age ~0 while healthy, inf before first sync."""
+    from kube_batch_tpu.recovery import ResilientWatcher
+
+    srv = SchedulerServer(listen_address="127.0.0.1:0", schedule_period=5.0)
+    srv.start()
+    w = ResilientWatcher(
+        f"http://127.0.0.1:{srv.listen_port}", ("queues",),
+        poll_timeout=0.5, min_backoff=0.01, relist_min_interval=0.05,
+    )
+    try:
+        assert w.snapshot_age() == float("inf")
+        w.start()
+        srv.store.create_queue(build_queue("tenant-a", weight=3))
+        wait_until(
+            lambda: set(w.mirror["queues"]) == {"default", "tenant-a"},
+            what="watcher mirror convergence",
+        )
+        assert w.snapshot_age() < 5.0
+        assert not w.stale(5.0)
+        srv.store.delete_queue("tenant-a")
+        wait_until(
+            lambda: set(w.mirror["queues"]) == {"default"},
+            what="delete propagates to the mirror",
+        )
+    finally:
+        w.stop()
+        srv.stop()
+
+
+def test_relist_coalescing_damps_a_gone_storm():
+    """Back-to-back relists are coalesced to one per interval: the
+    second call waits out the window (storm damper, not a tight loop)."""
+    from kube_batch_tpu.recovery import ResilientWatcher
+
+    srv = SchedulerServer(listen_address="127.0.0.1:0", schedule_period=5.0)
+    srv.start()
+    w = ResilientWatcher(
+        f"http://127.0.0.1:{srv.listen_port}", ("queues",),
+        poll_timeout=0.5, relist_min_interval=0.25,
+    )
+    try:
+        t0 = time.monotonic()
+        w.list_kind("queues")
+        w.list_kind("queues")  # inside the window: waits it out
+        assert time.monotonic() - t0 >= 0.25
+    finally:
+        w.stop()
+        srv.stop()
+
+
+# -- errTasks terminal drop (satellite) --------------------------------------
+
+
+def test_resync_queue_terminal_drop_after_retry_budget(monkeypatch):
+    """A permanently-unsyncable task is dropped from errTasks after its
+    retry budget, metered and narrated — it cannot ride the queue
+    forever."""
+    monkeypatch.setenv("KBT_RESYNC_MAX_RETRIES", "3")
+    store = ClusterStore()
+    store.create_queue(build_queue("default"))
+    cache = SchedulerCache(store)
+    from kube_batch_tpu.testing import build_task
+
+    ghost = build_task(name="ghost", group_name="nojob")
+    ghost.job = "default/nojob"  # no such job, no such pod: sync always fails
+    before = metrics.resync_dropped.value()
+    cache.resync_task(ghost)
+    deadline = time.monotonic() + 10
+    while len(cache._err_tasks) > 0 and time.monotonic() < deadline:
+        cache._process_resync_task()
+    assert len(cache._err_tasks) == 0, "task still riding the queue"
+    assert metrics.resync_dropped.value() == before + 1
+    # the failure count was forgotten with the drop: a LATER event for
+    # the same pod starts a fresh budget
+    assert cache._err_tasks.failures(ghost) == 0
