@@ -9,13 +9,20 @@ Runs, in order:
 2. `tabnanny` — no ambiguous indentation;
 3. an AST linter (stdlib-only, because this image ships no ruff/mypy
    and installs are off): unused imports (F401), bare except (E722),
-   `== None` / `!= None` comparisons (E711), mutable default arguments
-   (B006), and f-strings without placeholders (F541);
-4. ruff + mypy when importable (CI images that carry them get the full
+   `== None` / `!= None` comparisons — both operand sides — (E711),
+   mutable default arguments (B006), f-strings without placeholders
+   (F541);
+4. the domain-aware analysis suite (python -m kube_batch_tpu.analysis):
+   lock-discipline (KBT-L*), JAX hazards (KBT-J*), registry consistency
+   (KBT-R*), snapshot escape (KBT-S*), against the committed
+   hack/lint-baseline.toml (reason-less entries always fail; stale
+   entries fail under ``--strict``);
+5. ruff + mypy when importable (CI images that carry them get the full
    gate; their absence degrades to the stdlib checks, loudly — unless
    ``--strict``, which makes a missing tool a FAILURE, so an image
    rebuild that silently drops ruff/mypy cannot turn the gate green);
-5. the chaos smoke (kube_batch_tpu.faults.smoke): one injected fault per
+   mypy covers api/, framework/, conf/ and recovery/;
+6. the chaos smoke (kube_batch_tpu.faults.smoke): one injected fault per
    subsystem — solver, native boundary, cache write, watch hub, lease
    elector — plus a seeded cache-mutation-detector violation, each
    through a real scheduling path, asserting binds still land.
@@ -26,7 +33,12 @@ crash-consistent failover e2e), and ``kube_batch_tpu.recovery.fsck``
 against a seeded journal fixture (a known half-confirmed WAL must fsck
 clean with the expected orphan count, and ``--strict`` must gate on it).
 
-Exit 0 iff every gate is clean. Usage:  python hack/verify.py [--strict] [--chaos]
+Exit 0 iff every gate is clean.
+Usage:  python hack/verify.py [--strict] [--chaos] [--json]
+
+``--json`` appends one machine-readable summary line to stdout
+(per-gate pass/fail + finding counts) so bench/CI can record the
+gate's state in artifacts.
 
 CI/the deployment image run ``--strict`` (the Dockerfile installs ruff +
 mypy via the ``dev`` extra); the bare container, which cannot install
@@ -146,9 +158,14 @@ class _Lint(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Compare(self, node: ast.Compare) -> None:
-        for op, comp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                (isinstance(comp, ast.Constant) and comp.value is None)
+        # check BOTH sides of each comparison: `None == x` puts the
+        # constant in node.left (or, chained, in the previous
+        # comparator), which the comparators-only loop missed
+        operands = [node.left] + list(node.comparators)
+        for op, left, right in zip(node.ops, operands, operands[1:]):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and any(
+                isinstance(o, ast.Constant) and o.value is None
+                for o in (left, right)
             ):
                 self.problems.append(
                     (node.lineno, "E711 comparison to None (use `is`)")
@@ -274,16 +291,54 @@ def run_chaos_gate(env: dict) -> bool:
     return ok
 
 
+def run_analysis_gate(strict: bool) -> dict:
+    """The domain-aware suite as a subprocess (same pattern as the fsck
+    gate: the CLI is the contract). Returns a summary dict for --json."""
+    import json
+
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis", "--json"]
+        + (["--strict"] if strict else []),
+        cwd=REPO, capture_output=True, text=True,
+    )
+    summary: dict = {"ok": False, "counts": {}}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: analysis suite produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and summary.get("ok", False)
+    if not ok:
+        for f in summary.get("findings", []) + summary.get("baseline_errors", []):
+            print(f"{f['path']}:{f['line']}: {f['code']} {f['message']}")
+        if strict:
+            for f in summary.get("stale", []):
+                print(f"{f['path']}:{f['line']}: {f['code']} {f['message']}")
+        print("verify: analysis suite FAILED "
+              "(python -m kube_batch_tpu.analysis --explain CODE for any code)")
+    return {
+        "ok": ok,
+        "counts": summary.get("counts", {}),
+        "suppressed": summary.get("suppressed", 0),
+        "baseline_errors": len(summary.get("baseline_errors", [])),
+        "stale": len(summary.get("stale", [])),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
+    import json
+
     argv = sys.argv[1:] if argv is None else argv
     strict = "--strict" in argv
     chaos = "--chaos" in argv
-    unknown = [a for a in argv if a not in ("--strict", "--chaos")]
+    as_json = "--json" in argv
+    unknown = [a for a in argv if a not in ("--strict", "--chaos", "--json")]
     if unknown:
         print(f"verify: unknown argument(s): {' '.join(unknown)}")
         return 2
     files = py_files()
     failed = False
+    gates: dict = {}
 
     # 1. syntax
     ok = compileall.compile_dir(
@@ -291,6 +346,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     for single in files:
         ok = compileall.compile_file(single, quiet=2) and ok
+    gates["compileall"] = {"ok": bool(ok)}
     if not ok:
         print("verify: compileall FAILED")
         failed = True
@@ -307,23 +363,41 @@ def main(argv: list[str] | None = None) -> int:
         if buf.getvalue():
             print(buf.getvalue().strip())
             tab_problems += 1
+    gates["tabnanny"] = {"ok": tab_problems == 0, "flagged": tab_problems}
     if tab_problems:
         print(f"verify: tabnanny flagged {tab_problems} file(s)")
         failed = True
 
     # 3. AST lint
     n = run_ast_lint(files)
+    gates["ast_lint"] = {"ok": n == 0, "findings": n}
     if n:
         print(f"verify: AST lint found {n} problem(s)")
         failed = True
 
-    # 4. the full gate, when available
+    # 4. the domain-aware analysis suite (always on: it is stdlib-only,
+    # so the bare image runs it; --strict additionally rejects stale
+    # baseline entries)
+    gates["analysis"] = run_analysis_gate(strict)
+    if not gates["analysis"]["ok"]:
+        failed = True
+
+    # 5. the full generic gate, when available (mypy beyond api/ per
+    # VERDICT item 7: framework, conf and recovery carry the concurrency
+    # and failover contracts, where a None slip is a 3am page)
     for tool, args in (
         ("ruff", ["check", "kube_batch_tpu"]),
-        ("mypy", ["--ignore-missing-imports", "kube_batch_tpu/api"]),
+        ("mypy", [
+            "--ignore-missing-imports",
+            "kube_batch_tpu/api",
+            "kube_batch_tpu/framework",
+            "kube_batch_tpu/conf",
+            "kube_batch_tpu/recovery",
+        ]),
     ):
         rc = run_optional(tool, args)
         if rc is None:
+            gates[tool] = {"ok": not strict, "status": "unavailable"}
             if strict:
                 print(f"verify: {tool} unavailable — FAILED (--strict: "
                       "install the 'dev' extra: pip install -e '.[dev]')")
@@ -331,11 +405,13 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 print(f"verify: {tool} unavailable in this image — skipped "
                       "(stdlib gates above still ran; --strict to require)")
-        elif rc != 0:
-            print(f"verify: {tool} FAILED")
-            failed = True
+        else:
+            gates[tool] = {"ok": rc == 0, "status": "ran"}
+            if rc != 0:
+                print(f"verify: {tool} FAILED")
+                failed = True
 
-    # 5. chaos smoke — the failure drills must actually work here
+    # 6. chaos smoke — the failure drills must actually work here
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -346,16 +422,27 @@ def main(argv: list[str] | None = None) -> int:
     res = subprocess.run(
         [sys.executable, "-m", "kube_batch_tpu.faults.smoke"], cwd=REPO, env=env
     )
+    gates["chaos_smoke"] = {"ok": res.returncode == 0}
     if res.returncode != 0:
         print("verify: chaos smoke FAILED")
         failed = True
 
-    # 6. --chaos: the full chaos-marked suite + fsck on a seeded journal
-    if chaos and not run_chaos_gate(env):
-        failed = True
+    # 7. --chaos: the full chaos-marked suite + fsck on a seeded journal
+    if chaos:
+        chaos_ok = run_chaos_gate(env)
+        gates["chaos"] = {"ok": chaos_ok}
+        if not chaos_ok:
+            failed = True
 
     print("verify:", "FAILED" if failed else "ok",
           f"({len(files)} files)")
+    if as_json:
+        print(json.dumps({
+            "ok": not failed,
+            "strict": strict,
+            "files": len(files),
+            "gates": gates,
+        }, sort_keys=True))
     return 1 if failed else 0
 
 

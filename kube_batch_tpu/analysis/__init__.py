@@ -1,0 +1,414 @@
+"""Domain-aware static analysis suite (the third rail of ``make verify``).
+
+The generic half of the verify gate (hack/verify.py: compileall,
+tabnanny, the stdlib F401/E722/E711/B006/F541 linter, ruff+mypy when
+present) knows nothing about the invariants this codebase actually
+lives or dies by. This package encodes them as four analyzers, each
+stdlib-only (ast-based) so the bare container runs the full gate:
+
+- **A1 lock-discipline** (:mod:`.lock_discipline`, KBT-L0xx):
+  attributes declared guarded — via the seed map for the threaded
+  cache/store/workqueue/journal/watch-hub layers or a
+  ``#: guarded_by <lock>`` annotation — must only be touched lexically
+  inside ``with self.<lock>`` or in a method marked lock-held
+  (``_locked`` suffix / ``@assume_locked``). Catches the cross-thread
+  races the runtime mutation detector only sees if a test happens to
+  interleave.
+- **A2 JAX hazards** (:mod:`.jax_hazards`, KBT-J0xx): inside
+  jit/pjit/shard_map/pallas-reachable functions of ``ops/`` and
+  ``parallel/``, flag host syncs (``.item()``, ``.tolist()``,
+  ``np.asarray``, ``jax.device_get``, ``float()/int()`` on traced
+  values), Python truth tests on traced values, and bare ``print``;
+  plus raw ``float32/float64`` dtype literals in ``plugins/``/``api/``
+  that bypass the ``api/numerics.py`` comparison-dtype policy.
+- **A3 registry consistency** (:mod:`.registry_consistency`, KBT-R0xx):
+  every fault point fired exists in ``faults.POINTS`` and vice versa;
+  every ``metrics.<name>`` touched is declared in
+  ``metrics/__init__.py``; every ``KBT_*`` env var read appears in the
+  deployment runbook's env table, and no documented knob is dead.
+- **A4 snapshot escape** (:mod:`.snapshot_escape`, KBT-S0xx):
+  plugins/actions that mutate objects reached from a session snapshot
+  without going through the Statement / session APIs.
+
+Findings print as ``file:line: CODE message``. Intentional deviations
+live in a committed suppression file (``hack/lint-baseline.toml``);
+every entry requires a ``reason`` — a reason-less entry is itself a
+finding (KBT-B001), and under ``--strict`` so is a stale one
+(KBT-B002). CLI: ``python -m kube_batch_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "Suppression",
+    "Baseline",
+    "CODES",
+    "load_tree",
+    "load_baseline",
+    "apply_baseline",
+    "run_suite",
+    "repo_root",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: ``{path}:{line}: {code} {message}``.
+
+    ``symbol`` is the stable suppression key (qualified name + detail)
+    — baseline entries match on it instead of line numbers, which
+    drift."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    message: str
+    symbol: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.AST
+
+    @property
+    def lines(self) -> list[str]:
+        return self.source.splitlines()
+
+
+# code -> (one-line title, what it protects / how to fix) — the
+# ``--explain`` text and the runbook table's source of truth.
+CODES: dict[str, tuple[str, str]] = {
+    "KBT-L001": (
+        "guarded attribute touched without its lock",
+        "The attribute is declared guarded (seed map or `#: guarded_by "
+        "<lock>` annotation) but is read/written outside a lexical `with "
+        "self.<lock>` block, in a method not marked lock-held (`_locked` "
+        "suffix or @assume_locked). This is the cross-thread race class "
+        "the runtime mutation detector only catches if a test interleaves "
+        "— the resync workers, write pool, watch hub and HTTP handlers "
+        "all share these structures. Fix: take the lock, move the access "
+        "inside an existing critical section, or mark the helper "
+        "@assume_locked if every caller already holds it.",
+    ),
+    "KBT-L002": (
+        "guarded_by annotation names no known lock",
+        "A `#: guarded_by <lock>` annotation refers to an attribute that "
+        "is never assigned a threading.Lock/RLock/Condition in this "
+        "class. The guard would never be enforceable. Fix the annotation "
+        "or add the lock.",
+    ),
+    "KBT-J001": (
+        "host sync inside a jit-reachable function",
+        "`.item()`, `.tolist()`, `np.asarray`/`np.array`, "
+        "`jax.device_get`, or `float()/int()/bool()` on a traced value "
+        "forces a device->host transfer and blocks dispatch inside "
+        "traced code — on TPU this serializes the solve pipeline (and "
+        "under tracing it raises ConcretizationTypeError at runtime on "
+        "some paths the tests never walk). Fix: stay in jnp, or hoist "
+        "the host conversion outside the jitted entry.",
+    ),
+    "KBT-J002": (
+        "Python truth test on a traced value",
+        "`if`/`while`/`assert` on a traced array needs a concrete bool, "
+        "so it either host-syncs or raises TracerBoolConversionError "
+        "depending on the path. Use `jax.lax.cond`/`jnp.where`, or make "
+        "the flag a static argument.",
+    ),
+    "KBT-J003": (
+        "bare print inside a jit-reachable function",
+        "`print` runs at trace time (once per compile, not per step) "
+        "and silently prints tracers. Use `jax.debug.print` for runtime "
+        "values, or move the print outside the jitted entry.",
+    ),
+    "KBT-J004": (
+        "raw dtype literal bypasses the comparison-dtype policy",
+        "Comparison-feeding derived quantities (shares, fractions, "
+        "scores) must be computed in api/numerics.comparison_dtype() — "
+        "f32 when the kernels solve f32 — or the serial oracle disagrees "
+        "with the device kernels on sub-ulp ties (~0.5% of placements at "
+        "scale). A hard-coded np.float64/np.float32 in plugins/ or api/ "
+        "pins one side. Identity checks (`x is np.float64`) are exempt — "
+        "they consult the policy, they don't bypass it. Fix: use "
+        "comparison_dtype(); on-grid integral quantities that are exact "
+        "in every dtype may keep a literal with a baseline reason.",
+    ),
+    "KBT-R001": (
+        "fault point fired but not registered",
+        "faults.should_fire()/arm() is called with a point name missing "
+        "from faults.POINTS — the drill spec parser would reject it, so "
+        "the injection can never be armed and the degraded branch is "
+        "dead code. Add the point to POINTS (with its ladder/runbook "
+        "entry) or fix the typo.",
+    ),
+    "KBT-R002": (
+        "registered fault point never fired",
+        "A faults.POINTS entry has no should_fire() call site — drills "
+        "arming it silently inject nothing, which is exactly the "
+        "false-confidence failure chaos tooling exists to prevent. Wire "
+        "the point at the boundary it names or remove it.",
+    ),
+    "KBT-R003": (
+        "metric not declared in metrics/__init__.py",
+        "Code touches metrics.<name> but the metrics module defines no "
+        "such collector/helper — an AttributeError on a path that only "
+        "fires under failure (most metering sits in except blocks). "
+        "Declare the metric (with HELP text, and add it to "
+        "render_prometheus_text) or fix the name.",
+    ),
+    "KBT-R004": (
+        "KBT_* env var read but not documented in the runbook",
+        "An os.environ read of a KBT_* knob has no row in the deployment "
+        "runbook's environment table (deployment/README.md) — operators "
+        "cannot discover it, and drills/runbooks drift from reality. Add "
+        "the row (name, default, one-line semantics).",
+    ),
+    "KBT-R005": (
+        "documented KBT_* env knob is dead",
+        "The deployment runbook documents a KBT_* variable no code "
+        "reads — operators will set it and observe nothing. Remove the "
+        "row or restore the read.",
+    ),
+    "KBT-S001": (
+        "snapshot object mutated outside Statement/session APIs",
+        "A plugin/action assigns attributes on an object reached from "
+        "the session snapshot (ssn.jobs/nodes/queues) directly. Session "
+        "state must change through ssn.allocate/evict or a Statement so "
+        "the operation log can undo it on discard and the event handlers "
+        "(DRF/proportion shares) observe it; a silent direct write "
+        "desyncs shares and survives gang rollback. Route through the "
+        "session API, or baseline with the parity evidence if the "
+        "mutation is a vetted bulk-replay equivalent.",
+    ),
+    "KBT-S002": (
+        "snapshot object mutator called outside Statement/session APIs",
+        "A plugin/action calls a mutating method (add_task, remove_task, "
+        "update_task_status, ...) on a snapshot-derived job/node/task "
+        "directly instead of through ssn.allocate/evict or a Statement. "
+        "Same failure class as KBT-S001: no undo log, no events, shares "
+        "desync.",
+    ),
+    "KBT-B001": (
+        "baseline entry missing a reason",
+        "Every hack/lint-baseline.toml entry must say WHY the finding is "
+        "intentionally kept — a reason-less suppression is "
+        "indistinguishable from a silent skip and fails the gate.",
+    ),
+    "KBT-B002": (
+        "stale baseline entry",
+        "A suppression matches no current finding — the code it excused "
+        "changed. Delete the entry (strict mode fails on it so the "
+        "baseline can only shrink, never rot).",
+    ),
+}
+
+
+def repo_root() -> str:
+    """The tree to analyze: cwd when it holds the package (the normal
+    checkout / image layout), else the checkout containing this file."""
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, "kube_batch_tpu")):
+        return cwd
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_tree(repo: str, package: str = "kube_batch_tpu") -> list[SourceFile]:
+    """Parse every package .py (tests and this meta-layer excluded —
+    the generic hack/verify.py lint still covers both)."""
+    out: list[SourceFile] = []
+    pkg_dir = os.path.join(repo, package)
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = sorted(d for d in dirs if d not in ("__pycache__", "analysis"))
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            abspath = os.path.join(root, f)
+            rel = os.path.relpath(abspath, repo).replace(os.sep, "/")
+            with open(abspath, encoding="utf-8") as fh:
+                source = fh.read()
+            try:
+                tree = ast.parse(source, rel)
+            except SyntaxError:
+                continue  # compileall's problem, not ours
+            out.append(SourceFile(rel, source, tree))
+    return out
+
+
+# -- baseline (hack/lint-baseline.toml) --------------------------------------
+#
+# Parsed with a deliberately tiny TOML-subset reader: this image is
+# py3.10 (no tomllib) and installs are off. Grammar accepted: comments,
+# [[suppress]] table headers, and `key = "string"` pairs. Anything else
+# is a parse error (loud, so the file cannot quietly rot into a dialect
+# tomllib would later reject).
+
+_HEADER_RE = re.compile(r"^\[\[suppress\]\]\s*$")
+_PAIR_RE = re.compile(r'^(?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*=\s*"(?P<val>(?:[^"\\]|\\.)*)"\s*$')
+_KEYS = {"code", "path", "symbol", "reason"}
+
+
+@dataclass
+class Suppression:
+    code: str = ""
+    path: str = ""
+    symbol: str = ""  # empty = any symbol at (code, path)
+    reason: str = ""
+    line: int = 0  # line of the [[suppress]] header in the baseline
+    hits: int = 0  # findings matched this run
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            self.code == f.code
+            and self.path == f.path
+            and (not self.symbol or self.symbol == f.symbol)
+        )
+
+
+@dataclass
+class Baseline:
+    path: str
+    suppressions: list[Suppression] = field(default_factory=list)
+    errors: list[Finding] = field(default_factory=list)  # KBT-B001 + parse errors
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        if c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out).strip()
+
+
+def load_baseline(path: str, repo: str) -> Baseline:
+    rel = os.path.relpath(path, repo).replace(os.sep, "/")
+    bl = Baseline(path=rel)
+    if not os.path.exists(path):
+        return bl
+    cur: Optional[Suppression] = None
+    with open(path, encoding="utf-8") as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = _strip_comment(raw)
+            if not line:
+                continue
+            if _HEADER_RE.match(line):
+                cur = Suppression(line=lineno)
+                bl.suppressions.append(cur)
+                continue
+            m = _PAIR_RE.match(line)
+            if m and cur is not None and m.group("key") in _KEYS:
+                val = m.group("val").replace('\\"', '"').replace("\\\\", "\\")
+                setattr(cur, m.group("key"), val)
+                continue
+            bl.errors.append(
+                Finding(
+                    rel, lineno, "KBT-B001",
+                    f"unparseable baseline line {raw.strip()!r} (grammar: "
+                    '[[suppress]] tables of key = "value" pairs)',
+                    symbol=f"parse:{lineno}",
+                )
+            )
+    for s in bl.suppressions:
+        if not s.reason.strip():
+            bl.errors.append(
+                Finding(
+                    rel, s.line, "KBT-B001",
+                    f"suppression of {s.code or '<no code>'} at "
+                    f"{s.path or '<no path>'} has no reason — every entry "
+                    "must say why the finding is intentionally kept",
+                    symbol=f"{s.code}:{s.path}:{s.symbol}",
+                )
+            )
+        if not s.code or not s.path:
+            bl.errors.append(
+                Finding(
+                    rel, s.line, "KBT-B001",
+                    "suppression must name both `code` and `path`",
+                    symbol=f"incomplete:{s.line}",
+                )
+            )
+    return bl
+
+
+def apply_baseline(
+    findings: list[Finding], bl: Baseline
+) -> tuple[list[Finding], list[Finding], list[Finding]]:
+    """-> (kept, suppressed, stale) where stale are KBT-B002 findings
+    for suppressions that matched nothing."""
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in findings:
+        hit = None
+        for s in bl.suppressions:
+            if s.matches(f):
+                hit = s
+                break
+        if hit is not None:
+            hit.hits += 1
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    stale = [
+        Finding(
+            bl.path, s.line, "KBT-B002",
+            f"suppression of {s.code} at {s.path}"
+            + (f" ({s.symbol})" if s.symbol else "")
+            + " matches no current finding — delete it",
+            symbol=f"{s.code}:{s.path}:{s.symbol}",
+        )
+        for s in bl.suppressions
+        if s.hits == 0 and s.code and s.path
+    ]
+    return kept, suppressed, stale
+
+
+# -- suite -------------------------------------------------------------------
+
+
+def run_suite(
+    repo: Optional[str] = None,
+    files: Optional[list[SourceFile]] = None,
+    runbook: Optional[str] = None,
+) -> list[Finding]:
+    """Run all four analyzers over the tree; findings sorted by
+    (path, line, code). Baseline application is the caller's business
+    (the CLI and hack/verify.py both go through it)."""
+    from kube_batch_tpu.analysis import (
+        jax_hazards,
+        lock_discipline,
+        registry_consistency,
+        snapshot_escape,
+    )
+
+    repo = repo or repo_root()
+    if files is None:
+        files = load_tree(repo)
+    findings: list[Finding] = []
+    analyzers: list[Callable[..., list[Finding]]] = [
+        lock_discipline.analyze,
+        jax_hazards.analyze,
+        snapshot_escape.analyze,
+    ]
+    for analyze in analyzers:
+        findings.extend(analyze(files))
+    findings.extend(registry_consistency.analyze(files, repo=repo, runbook=runbook))
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return findings

@@ -1,0 +1,400 @@
+"""A2 — JAX hazard analyzer (KBT-J001..J004).
+
+Scope, by check:
+
+- **J001/J002/J003** run on ``ops/`` and ``parallel/`` (the solve
+  kernels), inside *jit-reachable* functions only. A function is
+  jit-reachable when it
+    * carries a ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)``
+      decorator, or
+    * is passed by name into ``jax.jit`` / ``shard_map`` /
+      ``pl.pallas_call`` / ``lax.while_loop|fori_loop|scan|cond|switch``
+      / ``vmap`` / ``pmap``, or
+    * is lexically nested inside a jit-reachable function, or
+    * is a same-module function *called by name* from a jit-reachable
+      function (one-module call closure — the kernels are factored as
+      module-level helpers invoked from the jitted entries).
+  Host work belongs in the pack/encode layers outside these functions;
+  inside them, a host sync stalls the device pipeline per trace and a
+  tracer truth-test is a latent ConcretizationTypeError on paths the
+  parity tests never walk.
+
+- **J004** runs on ``plugins/`` and ``api/`` (minus ``numerics.py``
+  itself): raw ``np/jnp.float32|float64`` dtype literals there bypass
+  the comparison-dtype policy that keeps the serial oracle bit-identical
+  to the f32 device kernels. Identity/equality *comparisons* against a
+  dtype literal are exempt — they consult the policy rather than bypass
+  it (``if comparison_dtype() is np.float64``).
+
+Known blind spots, deliberate: reachability does not cross modules, and
+closures stashed under a ``with``/callback boundary are attributed to
+their lexical position. Both trade recall for a zero-false-positive-ish
+default the gate can enforce; the chaos/parity suites cover the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Union
+
+from kube_batch_tpu.analysis import Finding, SourceFile
+
+_FuncDef = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+# call targets whose function-typed arguments become jit-reachable
+_TRACING_CALLS = {
+    "jit", "pjit", "shard_map", "pallas_call", "while_loop", "fori_loop",
+    "scan", "cond", "switch", "vmap", "pmap", "checkpoint", "remat",
+    "named_call", "custom_jvp", "custom_vjp", "when",
+}
+# attribute roots whose calls are device-side (not host syncs)
+_DEVICE_ROOTS = {"jnp", "lax", "pl", "plgpu", "pltpu", "jax"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_HOST_SYNC_NP = {"asarray", "array", "ascontiguousarray"}
+_SCALAR_CASTS = {"float", "int", "bool"}
+_DTYPE_LITERALS = {"float32", "float64"}
+_DTYPE_ROOTS = {"np", "jnp", "numpy"}
+
+
+def _callable_name(fn: ast.expr) -> str:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _attr_root(node: ast.expr) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _jit_decorated(fn: _FuncDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            # @partial(jax.jit, ...) / @jax.jit(...)
+            name = _callable_name(dec.func)
+            if name == "partial" and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func
+        if _callable_name(target) in ("jit", "pjit"):
+            return True
+    return False
+
+
+def _static_argnames(tree: ast.AST) -> set[str]:
+    """Every name listed in any static_argnames/static_argnums-adjacent
+    tuple in the module — parameters by these names are compile-time
+    constants, so truth tests on them are legal anywhere."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.keyword) and node.arg == "static_argnames":
+            v = node.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return names
+
+
+def _collect_jit_roots(tree: ast.AST) -> set[str]:
+    """Names of functions passed into tracing calls or jit-decorated."""
+    roots: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            if _callable_name(node.func) in _TRACING_CALLS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    target = arg
+                    if isinstance(arg, ast.Call) and _callable_name(arg.func) == "partial":
+                        target = arg.args[0] if arg.args else arg
+                    if isinstance(target, ast.Name):
+                        roots.add(target.id)
+    return roots
+
+
+def _index_functions(tree: ast.AST) -> dict[str, list[_FuncDef]]:
+    """name -> defs (module-level and nested share the namespace; shadowing
+    is resolved pessimistically by checking every def of the name)."""
+    out: dict[str, list[_FuncDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _called_names(fn: _FuncDef) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            names.add(node.func.id)
+    return names
+
+
+def _jit_scope_functions(tree: ast.AST) -> list[_FuncDef]:
+    """Transitive closure: roots + same-module functions they call, plus
+    every function nested inside any of those."""
+    by_name = _index_functions(tree)
+    work = sorted(_collect_jit_roots(tree))
+    reach: list[_FuncDef] = []
+    seen: set[int] = set()
+    while work:
+        name = work.pop()
+        for fn in by_name.get(name, []):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reach.append(fn)
+            for callee in sorted(_called_names(fn)):
+                if callee in by_name and any(
+                    id(d) not in seen for d in by_name[callee]
+                ):
+                    work.append(callee)
+    # nested defs inherit jit scope
+    out: list[_FuncDef] = []
+    for fn in reach:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) not in seen or node is fn:
+                    out.append(node)
+    return out
+
+
+class _ScopeChecker(ast.NodeVisitor):
+    """Hazard checks inside ONE jit-reachable function (its nested defs
+    are checked by their own _ScopeChecker; skip them here)."""
+
+    def __init__(
+        self,
+        sf: SourceFile,
+        fn: _FuncDef,
+        statics: set[str],
+        findings: list[Finding],
+    ) -> None:
+        self.sf = sf
+        self.fn = fn
+        self.findings = findings
+        a = fn.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        self.traced = {p for p in params if p not in statics and p != "self"}
+        self._root = True
+
+    def _flag(self, node: ast.AST, code: str, msg: str, sym: str) -> None:
+        lines = self.sf.lines
+        if 0 < node.lineno <= len(lines) and "noqa" in lines[node.lineno - 1]:
+            return
+        self.findings.append(
+            Finding(self.sf.path, node.lineno, code, msg,
+                    symbol=f"{self.fn.name}.{sym}")
+        )
+
+    # nested defs get their own checker
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self._root:
+            self._root = False
+            self.generic_visit(node)
+        # else: skip body; the nested def is in the scope list itself
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def run(self) -> None:
+        self.visit(self.fn)
+
+    # -- J001 / J003 --------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = _callable_name(fn)
+        if isinstance(fn, ast.Name):
+            if name == "print":
+                self._flag(
+                    node, "KBT-J003",
+                    f"bare print() inside jit-reachable `{self.fn.name}` "
+                    "(runs at trace time; use jax.debug.print)",
+                    "print",
+                )
+            elif name in _SCALAR_CASTS and node.args and not isinstance(
+                node.args[0], ast.Constant
+            ):
+                self._flag(
+                    node, "KBT-J001",
+                    f"{name}() on a non-constant inside jit-reachable "
+                    f"`{self.fn.name}` forces a host sync (or a tracer "
+                    "concretization error)",
+                    name,
+                )
+        elif isinstance(fn, ast.Attribute):
+            root = _attr_root(fn)
+            if fn.attr in _HOST_SYNC_METHODS:
+                self._flag(
+                    node, "KBT-J001",
+                    f".{fn.attr}() inside jit-reachable `{self.fn.name}` "
+                    "is a device->host sync",
+                    fn.attr,
+                )
+            elif root in ("np", "numpy") and fn.attr in _HOST_SYNC_NP:
+                self._flag(
+                    node, "KBT-J001",
+                    f"np.{fn.attr} inside jit-reachable `{self.fn.name}` "
+                    "materializes on host (use jnp)",
+                    f"np.{fn.attr}",
+                )
+            elif root == "jax" and fn.attr == "device_get":
+                self._flag(
+                    node, "KBT-J001",
+                    f"jax.device_get inside jit-reachable `{self.fn.name}` "
+                    "is a device->host sync",
+                    "device_get",
+                )
+        self.generic_visit(node)
+
+    # -- J002 ---------------------------------------------------------------
+
+    def _test_is_traced(self, test: ast.expr) -> Optional[str]:
+        """A reason string when the truth-tested expression involves
+        traced data; None when it looks host-static. Static-at-trace
+        subtrees are pruned: identity tests (``x is None`` selects the
+        fresh/resume program shape), ``.dtype`` attribute chains and
+        ``jnp.issubdtype`` (dtype metadata is compile-time)."""
+
+        def scan(node: ast.expr) -> Optional[str]:
+            if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return None  # identity: structural/static dispatch
+            if isinstance(node, ast.Attribute) and node.attr == "dtype":
+                return None  # dtype metadata is static under tracing
+            if isinstance(node, ast.Call):
+                name = _callable_name(node.func)
+                root = _attr_root(node.func)
+                if name in ("issubdtype", "isinstance", "len"):
+                    return None
+                if root in ("jnp", "lax"):
+                    return f"result of {root}.{name}"
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.traced:
+                    return f"parameter `{node.id}`"
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    why = scan(child)
+                    if why is not None:
+                        return why
+            return None
+
+        return scan(test)
+
+    def _check_test(self, node: ast.AST, test: ast.expr, kind: str) -> None:
+        why = self._test_is_traced(test)
+        if why is not None:
+            self._flag(
+                node, "KBT-J002",
+                f"Python {kind} on a traced value ({why}) inside "
+                f"jit-reachable `{self.fn.name}` — use lax.cond/jnp.where "
+                "or make it a static argument",
+                f"{kind}:{why}",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_test(node, node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_test(node, node.test, "assert")
+        self.generic_visit(node)
+
+
+# -- J004 --------------------------------------------------------------------
+
+
+class _DtypeChecker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, findings: list[Finding]) -> None:
+        self.sf = sf
+        self.findings = findings
+        self.scope: list[str] = []
+        self.exempt: set[int] = set()  # ids of literals inside identity checks
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `x is np.float64` / `x == np.float32` consult the policy
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq)) for op in node.ops):
+            for operand in [node.left] + node.comparators:
+                if self._is_dtype_literal(operand):
+                    self.exempt.add(id(operand))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_dtype_literal(node: ast.expr) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr in _DTYPE_LITERALS
+            and _attr_root(node) in _DTYPE_ROOTS
+        )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self._is_dtype_literal(node) and id(node) not in self.exempt:
+            lines = self.sf.lines
+            if not (0 < node.lineno <= len(lines) and "noqa" in lines[node.lineno - 1]):
+                root = _attr_root(node)
+                where = ".".join(self.scope) or "<module>"
+                self.findings.append(
+                    Finding(
+                        self.sf.path, node.lineno, "KBT-J004",
+                        f"raw {root}.{node.attr} in `{where}` bypasses the "
+                        "comparison-dtype policy (api/numerics."
+                        "comparison_dtype) — derived quantities computed "
+                        "here can disagree with the f32 device kernels on "
+                        "sub-ulp ties",
+                        symbol=f"{where}.{root}.{node.attr}",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def analyze(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        in_kernels = sf.path.startswith(
+            ("kube_batch_tpu/ops/", "kube_batch_tpu/parallel/")
+        )
+        in_policy = sf.path.startswith(
+            ("kube_batch_tpu/plugins/", "kube_batch_tpu/api/")
+        ) and not sf.path.endswith("numerics.py")
+        if in_kernels:
+            statics = _static_argnames(sf.tree)
+            for fn in _jit_scope_functions(sf.tree):
+                _ScopeChecker(sf, fn, statics, findings).run()
+        if in_policy:
+            _DtypeChecker(sf, findings).visit(sf.tree)
+    # one finding per (path, line, code, symbol): nested scopes can
+    # enumerate the same def twice
+    seen: set[tuple] = set()
+    out = []
+    for f in findings:
+        key = (f.path, f.line, f.code, f.symbol)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

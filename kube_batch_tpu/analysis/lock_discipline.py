@@ -1,0 +1,290 @@
+"""A1 — lock-discipline analyzer (KBT-L001/L002).
+
+Model: a class owns locks (``threading.Lock/RLock/Condition`` attributes)
+and *guarded* attributes. An access to ``self.<guarded>`` is legal when
+
+- it is lexically inside a ``with self.<lock>:`` block for the guarding
+  lock (aliases of the same lock are not tracked — one lock, one name);
+- or the enclosing method is marked lock-held: name ends in ``_locked``
+  or it carries an ``@assume_locked`` decorator
+  (kube_batch_tpu.utils.locking);
+- or the enclosing method is ``__init__`` / ``__del__`` (construction
+  and teardown happen before/after the object is shared).
+
+Guarded attributes come from two sources, merged:
+
+- the committed **seed map** below for the threaded layers that predate
+  the annotation convention (cache/cache.py, cache/store.py, server.py,
+  recovery/journal.py, utils/workqueue.py);
+- a ``#: guarded_by <lock>`` trailing comment anywhere a
+  ``self.<attr>`` is assigned (conventionally the ``__init__``
+  declaration line) — new code self-documents its discipline and the
+  analyzer picks it up with zero configuration.
+
+The check is lexical, not interprocedural: a helper that is only ever
+called under the lock must *say so* (``_locked`` suffix or decorator) —
+that promise is exactly the documentation the next reader needs, so the
+analyzer treating silence as a violation is a feature.
+
+Functions nested inside a ``with`` block inherit its lock context;
+callbacks stashed for later execution on another thread are therefore
+invisible to this analyzer (keep handlers out of critical sections).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kube_batch_tpu.analysis import Finding, SourceFile
+
+# file -> class -> {guarded attr -> lock attr}. Keep entries for
+# attributes whose every post-construction touch must hold the lock;
+# attributes that are write-once-at-init (executor handles, config
+# ints) stay out.
+SEED_GUARDED: dict[str, dict[str, dict[str, str]]] = {
+    "kube_batch_tpu/cache/cache.py": {
+        "SchedulerCache": {
+            "jobs": "_mutex",
+            "nodes": "_mutex",
+            "queues": "_mutex",
+            "priority_classes": "_mutex",
+            "_default_priority_class": "_mutex",
+            "_default_priority": "_mutex",
+        },
+        "StoreVolumeBinder": {
+            "_pvs": "_lock",
+            "_pvcs": "_lock",
+            "_classes": "_lock",
+            "_assumed": "_lock",
+            "_reserved": "_lock",
+        },
+    },
+    "kube_batch_tpu/cache/store.py": {
+        "ClusterStore": {
+            "_kinds": "_lock",
+            "_events": "_lock",
+        },
+    },
+    "kube_batch_tpu/server.py": {
+        "WatchHub": {
+            "_events": "_cond",
+            "_seq": "_cond",
+            "_dropped": "_cond",
+            "_closed": "_cond",
+            "_active": "_cond",
+            "_journal_start": "_cond",
+        },
+    },
+    "kube_batch_tpu/recovery/journal.py": {
+        "WriteIntentJournal": {
+            "_outstanding": "_lock",
+            "_next_seq": "_lock",
+            "_confirmed_since_compact": "_lock",
+            "_fh": "_lock",
+        },
+    },
+    "kube_batch_tpu/utils/workqueue.py": {
+        "RateLimitingQueue": {
+            "_heap": "_cond",
+            "_items": "_cond",
+            "_pending": "_cond",
+            "_processing": "_cond",
+            "_dirty": "_cond",
+            "_failures": "_cond",
+            "_seq": "_cond",
+            "_shutdown": "_cond",
+        },
+    },
+}
+
+_ANNOT_RE = re.compile(r"#:\s*guarded_by\s+(?P<lock>[A-Za-z_][A-Za-z0-9_]*)")
+_SELF_ATTR_RE = re.compile(r"self\.(?P<attr>[A-Za-z_][A-Za-z0-9_]*)")
+
+_EXEMPT_METHODS = ("__init__", "__del__")
+
+
+def _annotated_guards(sf: SourceFile) -> dict[str, dict[str, str]]:
+    """class -> {attr -> lock} from `#: guarded_by <lock>` comments."""
+    line_guard: dict[int, str] = {}
+    for i, line in enumerate(sf.lines, 1):
+        m = _ANNOT_RE.search(line)
+        if m:
+            line_guard[i] = m.group("lock")
+    if not line_guard:
+        return {}
+    out: dict[str, dict[str, str]] = {}
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            lock = line_guard.get(node.lineno)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.setdefault(cls.name, {})[t.attr] = lock
+    return out
+
+
+def _class_locks(cls: ast.ClassDef) -> set[str]:
+    """Attrs assigned a threading lock/condition anywhere in the class."""
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name in ("Lock", "RLock", "Condition"):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        locks.add(t.attr)
+    return locks
+
+
+def _is_assume_locked(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else (
+            d.id if isinstance(d, ast.Name) else ""
+        )
+        if name == "assume_locked":
+            return True
+    return False
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one method body tracking the set of locks lexically held."""
+
+    def __init__(
+        self,
+        sf: SourceFile,
+        cls: str,
+        method: str,
+        guards: dict[str, str],
+        findings: list[Finding],
+    ) -> None:
+        self.sf = sf
+        self.cls = cls
+        self.method = method
+        self.guards = guards
+        self.findings = findings
+        self.held: list[str] = []
+        self.reported: set[tuple[int, str]] = set()
+
+    def _with_locks(self, node: ast.With) -> list[str]:
+        acquired = []
+        for item in node.items:
+            e = item.context_expr
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+                and e.attr in self.guards.values()
+            ):
+                acquired.append(e.attr)
+        return acquired
+
+    def visit_With(self, node: ast.With) -> None:
+        # context expressions evaluate before the locks are held
+        for item in node.items:
+            self.visit(item.context_expr)
+        acquired = self._with_locks(node)
+        self.held.extend(acquired)
+        for item in node.items:
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            lock = self.guards.get(node.attr)
+            if lock is not None and lock not in self.held:
+                key = (node.lineno, node.attr)
+                if key not in self.reported and not self._noqa(node.lineno):
+                    self.reported.add(key)
+                    self.findings.append(
+                        Finding(
+                            self.sf.path,
+                            node.lineno,
+                            "KBT-L001",
+                            f"self.{node.attr} is guarded by self.{lock} but "
+                            f"accessed in {self.cls}.{self.method} without it "
+                            "(wrap in `with`, or mark the method _locked/"
+                            "@assume_locked if every caller holds it)",
+                            symbol=f"{self.cls}.{self.method}.{node.attr}",
+                        )
+                    )
+        self.generic_visit(node)
+
+    def _noqa(self, lineno: int) -> bool:
+        lines = self.sf.lines
+        return 0 < lineno <= len(lines) and "noqa" in lines[lineno - 1]
+
+
+def analyze(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        seed = SEED_GUARDED.get(sf.path, {})
+        annotated = _annotated_guards(sf)
+        if not seed and not annotated:
+            continue
+        for cls in sf.tree.body if isinstance(sf.tree, ast.Module) else []:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guards = dict(seed.get(cls.name, {}))
+            guards.update(annotated.get(cls.name, {}))
+            if not guards:
+                continue
+            locks = _class_locks(cls)
+            for attr, lock in sorted(guards.items()):
+                if lock not in locks:
+                    findings.append(
+                        Finding(
+                            sf.path,
+                            cls.lineno,
+                            "KBT-L002",
+                            f"{cls.name}.{attr} declared guarded by "
+                            f"self.{lock}, but no threading.Lock/RLock/"
+                            f"Condition is ever assigned to self.{lock} "
+                            "in this class",
+                            symbol=f"{cls.name}.{attr}",
+                        )
+                    )
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in _EXEMPT_METHODS or meth.name.endswith("_locked"):
+                    continue
+                if _is_assume_locked(meth):
+                    continue
+                checker = _MethodChecker(sf, cls.name, meth.name, guards, findings)
+                for stmt in meth.body:
+                    checker.visit(stmt)
+    return findings
+
+
+def explain_convention() -> str:
+    """One paragraph for docs/--explain surfaces."""
+    return (
+        "Declare guards with `#: guarded_by <lock>` on the attribute's "
+        "__init__ assignment line (or the seed map for pre-existing "
+        "layers). Access them only inside `with self.<lock>`; helpers "
+        "called with the lock held are named *_locked or decorated "
+        "@assume_locked (kube_batch_tpu.utils.locking)."
+    )

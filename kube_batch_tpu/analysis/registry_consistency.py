@@ -1,0 +1,330 @@
+"""A3 — registry-consistency analyzer (KBT-R001..R005).
+
+Three registries grew to dozens of names across PR 1-3, each previously
+checked only by grep and luck:
+
+- **fault points**: the literal first argument of every
+  ``faults.should_fire(...)`` / ``registry.arm(...)`` call must exist in
+  ``faults.POINTS`` (R001), and every ``POINTS`` entry must have a call
+  site (R002) — an unfired point is a drill that silently injects
+  nothing. Dynamic names built from f-strings with constant fragments
+  (``f"{op}.write"``) are matched as wildcards: the pattern must match
+  at least one registered point, and any point it matches counts as
+  fired.
+- **metrics**: every ``metrics.<name>`` attribute touched in package
+  code must be defined at module level of ``metrics/__init__.py``
+  (R003) — most metering sits in ``except`` blocks, so a typo is an
+  AttributeError on exactly the path that only runs during an outage.
+- **env knobs**: every ``KBT_*`` variable the package reads must have a
+  row in the deployment runbook's environment table (R004), and every
+  documented row must still be read somewhere (R005). Reads are
+  collected from ``os.environ`` get/subscript/setdefault/pop calls,
+  from ``*env*``-named helper calls with a literal ``KBT_*`` first
+  argument (``_env_int("KBT_...", d)``), and from module-level
+  ALL-CAPS constants bound to a ``KBT_*`` string (the
+  ``ENV = "KBT_..."`` indirection in mutation_detector).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from fnmatch import fnmatchcase
+from typing import Optional
+
+from kube_batch_tpu.analysis import Finding, SourceFile
+
+FAULTS_MODULE = "kube_batch_tpu/faults/__init__.py"
+METRICS_MODULE = "kube_batch_tpu/metrics/__init__.py"
+RUNBOOK = "deployment/README.md"
+
+_ENV_RE = re.compile(r"^KBT_[A-Z0-9_]+$")
+_DOC_ENV_RE = re.compile(r"`(KBT_[A-Z0-9_]+)`")
+
+
+def _attr_root(node: ast.expr) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+# -- fault points ------------------------------------------------------------
+
+
+def _declared_points(files: list[SourceFile]) -> dict[str, int]:
+    """point -> lineno of its POINTS element."""
+    for sf in files:
+        if sf.path != FAULTS_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "POINTS":
+                        v = node.value
+                        if isinstance(v, (ast.Tuple, ast.List)):
+                            return {
+                                e.value: e.lineno
+                                for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            }
+    return {}
+
+
+def _point_arg(call: ast.Call) -> Optional[tuple[str, bool]]:
+    """(name-or-pattern, is_pattern) for the call's first argument."""
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value, False
+    if isinstance(a, ast.JoinedStr):
+        parts = []
+        for v in a.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        pattern = "".join(parts)
+        return pattern, True
+    return None  # a variable — not statically checkable
+
+
+def _check_fault_points(files: list[SourceFile], findings: list[Finding]) -> None:
+    declared = _declared_points(files)
+    if not declared:
+        return
+    fired: set[str] = set()
+    for sf in files:
+        if sf.path == FAULTS_MODULE:
+            continue  # the registry's own wrapper/arm plumbing
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name not in ("should_fire", "arm"):
+                continue
+            got = _point_arg(node)
+            if got is None:
+                continue
+            point, is_pattern = got
+            if is_pattern:
+                hits = [p for p in declared if fnmatchcase(p, point)]
+                if hits:
+                    fired.update(hits)
+                else:
+                    findings.append(
+                        Finding(
+                            sf.path, node.lineno, "KBT-R001",
+                            f"dynamic fault point pattern {point!r} matches "
+                            "no entry in faults.POINTS",
+                            symbol=f"point:{point}",
+                        )
+                    )
+            elif point in declared:
+                fired.add(point)
+            else:
+                findings.append(
+                    Finding(
+                        sf.path, node.lineno, "KBT-R001",
+                        f"fault point {point!r} is not registered in "
+                        "faults.POINTS — arm() would reject it, the drill "
+                        "can never fire",
+                        symbol=f"point:{point}",
+                    )
+                )
+    for point, lineno in sorted(declared.items()):
+        if point not in fired:
+            findings.append(
+                Finding(
+                    FAULTS_MODULE, lineno, "KBT-R002",
+                    f"fault point {point!r} is registered but no "
+                    "should_fire()/arm() call site fires it — drills "
+                    "arming it inject nothing",
+                    symbol=f"point:{point}",
+                )
+            )
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def _metrics_exports(files: list[SourceFile]) -> set[str]:
+    names: set[str] = set()
+    for sf in files:
+        if sf.path != METRICS_MODULE:
+            continue
+        mod = sf.tree
+        assert isinstance(mod, ast.Module)
+        for node in mod.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+    return names
+
+
+def _metrics_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to the metrics module in this file."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "kube_batch_tpu":
+                for a in node.names:
+                    if a.name == "metrics":
+                        aliases.add(a.asname or a.name)
+            elif node.module == "kube_batch_tpu.metrics":
+                continue  # direct symbol imports resolve at import time
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "kube_batch_tpu.metrics" and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+def _check_metrics(files: list[SourceFile], findings: list[Finding]) -> None:
+    exported = _metrics_exports(files)
+    if not exported:
+        return
+    for sf in files:
+        if sf.path == METRICS_MODULE:
+            continue
+        aliases = _metrics_aliases(sf.tree)
+        if not aliases:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in aliases
+                and node.attr not in exported
+            ):
+                findings.append(
+                    Finding(
+                        sf.path, node.lineno, "KBT-R003",
+                        f"metrics.{node.attr} is not declared in "
+                        "metrics/__init__.py — AttributeError on the "
+                        "(likely failure-only) path that reaches it",
+                        symbol=f"metric:{node.attr}",
+                    )
+                )
+
+
+# -- env knobs ---------------------------------------------------------------
+
+
+def _env_reads(files: list[SourceFile]) -> dict[str, tuple[str, int]]:
+    """var -> (path, line) of one read site."""
+    reads: dict[str, tuple[str, int]] = {}
+
+    def note(var: str, sf: SourceFile, lineno: int) -> None:
+        reads.setdefault(var, (sf.path, lineno))
+
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else ""
+                )
+                env_call = False
+                if isinstance(fn, ast.Attribute):
+                    chain = ast.dump(fn.value) if fn.value else ""
+                    env_call = "environ" in chain and fname in (
+                        "get", "pop", "setdefault", "__getitem__"
+                    )
+                env_call = env_call or "env" in fname.lower() or fname == "getenv"
+                if env_call and node.args:
+                    a = node.args[0]
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        if _ENV_RE.match(a.value):
+                            note(a.value, sf, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                v = node.value
+                if isinstance(v, ast.Attribute) and v.attr == "environ":
+                    s = node.slice
+                    if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                        if _ENV_RE.match(s.value):
+                            note(s.value, sf, node.lineno)
+        # ALL-CAPS module constants bound to a KBT_* string (indirection)
+        mod = sf.tree
+        if isinstance(mod, ast.Module):
+            for node in mod.body:
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                    val = node.value.value
+                    if isinstance(val, str) and _ENV_RE.match(val):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name) and t.id.isupper():
+                                note(val, sf, node.lineno)
+    return reads
+
+
+def _documented_env(repo: str, runbook: str) -> Optional[dict[str, int]]:
+    """var -> line in the runbook env table; None when the runbook is
+    absent (partial checkouts skip the doc cross-check, loudly at the
+    CLI layer)."""
+    path = os.path.join(repo, runbook)
+    if not os.path.exists(path):
+        return None
+    out: dict[str, int] = {}
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            m = _DOC_ENV_RE.search(line.split("|")[1] if line.count("|") > 1 else line)
+            if m:
+                out.setdefault(m.group(1), lineno)
+    return out
+
+
+def _check_env(
+    files: list[SourceFile], repo: str, runbook: str, findings: list[Finding]
+) -> None:
+    documented = _documented_env(repo, runbook)
+    if documented is None:
+        return
+    reads = _env_reads(files)
+    for var, (path, lineno) in sorted(reads.items()):
+        if var not in documented:
+            findings.append(
+                Finding(
+                    path, lineno, "KBT-R004",
+                    f"{var} is read here but has no row in the deployment "
+                    f"runbook's environment table ({runbook})",
+                    symbol=f"env:{var}",
+                )
+            )
+    for var, lineno in sorted(documented.items()):
+        if var not in reads:
+            findings.append(
+                Finding(
+                    runbook, lineno, "KBT-R005",
+                    f"{var} is documented in the environment table but no "
+                    "package code reads it — the knob is dead",
+                    symbol=f"env:{var}",
+                )
+            )
+
+
+def analyze(
+    files: list[SourceFile],
+    repo: Optional[str] = None,
+    runbook: Optional[str] = None,
+) -> list[Finding]:
+    from kube_batch_tpu.analysis import repo_root
+
+    repo = repo or repo_root()
+    runbook = runbook or RUNBOOK
+    findings: list[Finding] = []
+    _check_fault_points(files, findings)
+    _check_metrics(files, findings)
+    _check_env(files, repo, runbook, findings)
+    return findings

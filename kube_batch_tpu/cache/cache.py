@@ -68,6 +68,7 @@ from kube_batch_tpu.cache.store import (
     ClusterStore,
     EventHandler,
 )
+from kube_batch_tpu.utils.locking import assume_locked
 from kube_batch_tpu.utils.workqueue import RateLimitingQueue
 
 SHADOW_POD_GROUP_KEY = "kube-batch-tpu/shadow-pod-group"
@@ -308,6 +309,7 @@ class StoreVolumeBinder:
                 self._assumed.setdefault(task.uid, {}).update(assumed)
             task.volume_ready = all_bound
 
+    @assume_locked
     def _find_best_pv(self, pvc, pvc_key: str, node_labels: dict, exclude=frozenset()):
         """Smallest Available PV matching class/capacity/topology, not
         reserved by another assumption nor picked for a sibling claim of
@@ -589,6 +591,7 @@ class SchedulerCache:
 
     # -- job/task primitives (reference event_handlers.go:43-180) ----------
 
+    @assume_locked
     def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
         if not ti.job:
             if ti.pod.scheduler_name != self.scheduler_name:
@@ -608,6 +611,7 @@ class SchedulerCache:
             self.jobs[ti.job] = JobInfo(ti.job)
         return self.jobs[ti.job]
 
+    @assume_locked
     def _add_task(self, ti: TaskInfo) -> None:
         job = self._get_or_create_job(ti)
         if job is not None:
@@ -618,9 +622,11 @@ class SchedulerCache:
             if not _is_terminated(ti.status):
                 self.nodes[ti.node_name].add_task(ti)
 
+    @assume_locked
     def _add_pod(self, pod: Pod) -> None:
         self._add_task(TaskInfo(pod))
 
+    @assume_locked
     def _delete_task(self, ti: TaskInfo) -> None:
         job_err = node_err = None
         if ti.job:
@@ -646,6 +652,7 @@ class SchedulerCache:
         if job_err or node_err:
             raise KeyError(f"{job_err or ''}; {node_err or ''}")
 
+    @assume_locked
     def _update_task(self, old: TaskInfo, new: TaskInfo) -> None:
         self._delete_task(old)
         self._add_task(new)
@@ -661,6 +668,7 @@ class SchedulerCache:
                 pi.pod.namespace, pi.pod.metadata.owner_job or pi.pod.metadata.uid
             )
 
+    @assume_locked
     def _delete_pod(self, pod: Pod) -> None:
         pi = TaskInfo(pod)
         self._resolve_shadow_job(pi)
@@ -752,6 +760,7 @@ class SchedulerCache:
 
     # -- podgroup handlers (reference event_handlers.go:372-493) -----------
 
+    @assume_locked
     def _set_pod_group(self, pg: PodGroup) -> None:
         jid = job_key(pg.metadata.namespace, pg.name)
         if jid not in self.jobs:
@@ -799,6 +808,7 @@ class SchedulerCache:
             job.unset_pdb()
             self._delete_job(job)
 
+    @assume_locked
     def _set_pdb(self, pdb: PodDisruptionBudget) -> None:
         jid = pdb.metadata.owner_job or f"{pdb.metadata.namespace}/{pdb.name}"
         if jid not in self.jobs:
@@ -840,6 +850,7 @@ class SchedulerCache:
         with self._mutex:
             self._delete_priority_class(pc)
 
+    @assume_locked
     def _add_priority_class(self, pc: PriorityClass) -> None:
         if pc.global_default:
             if self._default_priority_class is not None:
@@ -851,6 +862,7 @@ class SchedulerCache:
             self._default_priority = pc.value
         self.priority_classes[pc.name] = pc
 
+    @assume_locked
     def _delete_priority_class(self, pc: PriorityClass) -> None:
         if pc.global_default:
             self._default_priority_class = None
@@ -859,6 +871,7 @@ class SchedulerCache:
 
     # -- write side (reference cache.go:369-448) ---------------------------
 
+    @assume_locked
     def _find_job_and_task(self, ti: TaskInfo) -> tuple[JobInfo, TaskInfo]:
         job = self.jobs.get(ti.job)
         if job is None:

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from kube_batch_tpu import log
+from kube_batch_tpu.utils.locking import assume_locked
 from kube_batch_tpu.apis.types import (
     Lease,
     Node,
@@ -162,6 +163,7 @@ class ClusterStore:
 
     # -- CRUD --------------------------------------------------------------
 
+    @assume_locked
     def _ks(self, kind: str) -> _KindStore:
         ks = self._kinds.get(kind)
         if ks is None:

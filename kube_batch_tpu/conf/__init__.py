@@ -24,9 +24,12 @@ Every per-plugin enable flag defaults to True when unset
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 import yaml
+
+if TYPE_CHECKING:
+    from kube_batch_tpu.framework.interface import Action
 
 _ENABLE_FLAGS = (
     "enabled_job_order",
@@ -153,14 +156,16 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
     return conf
 
 
-def load_scheduler_conf(conf_str: str):
+def load_scheduler_conf(
+    conf_str: str,
+) -> tuple[list["Action"], list[Tier], dict[str, dict[str, str]]]:
     """YAML -> ([Action], [Tier], action_arguments); unknown action names
     raise (reference util.go:44-73). Imported lazily to avoid a framework
     import cycle."""
     from kube_batch_tpu.framework import get_action
 
     conf = parse_scheduler_conf(conf_str)
-    actions = []
+    actions: list["Action"] = []
     for action_name in conf.actions.split(","):
         name = action_name.strip()
         if not name:

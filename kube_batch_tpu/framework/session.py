@@ -27,7 +27,12 @@ from __future__ import annotations
 
 import time
 import uuid as _uuid
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
+
+if TYPE_CHECKING:
+    # runtime import stays inside Session.statement() — statement.py
+    # imports this module's Session for ITS annotations (same cycle)
+    from kube_batch_tpu.framework.statement import Statement
 
 from kube_batch_tpu import metrics
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo

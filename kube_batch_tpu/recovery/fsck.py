@@ -56,7 +56,7 @@ def fsck(path: str) -> dict:
     }
 
 
-def main(argv=None) -> int:
+def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="kube_batch_tpu.recovery.fsck",
         description="check a bind-intent journal for in-flight writes",

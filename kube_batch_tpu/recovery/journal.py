@@ -44,6 +44,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu.utils.locking import assume_locked
 
 
 @dataclass(frozen=True)
@@ -156,8 +157,8 @@ class WriteIntentJournal:
         if compact:
             self.compact()
 
+    @assume_locked
     def _write(self, data: str) -> None:
-        # lock held by caller
         self._fh.write(data)
         self._fh.flush()
         if self.fsync:
@@ -198,7 +199,8 @@ class WriteIntentJournal:
             os.replace(tmp, self.path)
             self._fh = open(self.path, "a", encoding="utf-8")  # noqa: SIM115
             self._confirmed_since_compact = 0
-        log.V(3).infof("journal %s compacted (%d outstanding)", self.path, len(self._outstanding))
+            outstanding = len(self._outstanding)
+        log.V(3).infof("journal %s compacted (%d outstanding)", self.path, outstanding)
 
     def close(self) -> None:
         with self._lock:

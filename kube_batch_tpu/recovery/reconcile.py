@@ -37,10 +37,15 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
 
 from kube_batch_tpu import faults, log, metrics
 from kube_batch_tpu.apis.types import PodPhase
 from kube_batch_tpu.recovery.journal import Intent, WriteIntentJournal
+
+if TYPE_CHECKING:
+    from kube_batch_tpu.apis.types import Pod
+    from kube_batch_tpu.cache.store import ClusterStore
 
 
 @dataclass
@@ -52,7 +57,7 @@ class ReconcileReport:
     redispatched: int = 0  # orphaned writes re-driven through the store
     conflicts: int = 0  # store truth diverged; left alone
     rolled_back: int = 0  # binds undone for gang atomicity
-    gangs_rolled_back: list = field(default_factory=list)
+    gangs_rolled_back: list[str] = field(default_factory=list)
     aborted: bool = False  # scan died mid-way (journal.replay / reconcile.scan)
 
     def as_dict(self) -> dict:
@@ -73,15 +78,15 @@ class _GangStatement:
     (framework/statement.py's contract against the store instead of a
     session)."""
 
-    def __init__(self, store) -> None:
+    def __init__(self, store: "ClusterStore") -> None:
         self._store = store
         self._ops: list[tuple[str, str]] = []  # (op, pod_key)
 
-    def bind(self, pod, node: str) -> None:
+    def bind(self, pod: "Pod", node: str) -> None:
         self._store.update_pod(dataclasses.replace(pod, node_name=node))
         self._ops.append(("bind", f"{pod.namespace}/{pod.name}"))
 
-    def evict(self, pod) -> None:
+    def evict(self, pod: "Pod") -> None:
         self._store.delete_pod(pod.namespace, pod.name)
         self._ops.append(("evict", f"{pod.namespace}/{pod.name}"))
 
@@ -106,7 +111,7 @@ class _GangStatement:
         return undone
 
 
-def _unbind_landed(store, intents: list[Intent]) -> int:
+def _unbind_landed(store: "ClusterStore", intents: list[Intent]) -> int:
     """Roll back the already-landed binds of a gang statement (the ones
     the dead leader's write pool completed before the crash)."""
     undone = 0
@@ -125,7 +130,9 @@ def _unbind_landed(store, intents: list[Intent]) -> int:
     return undone
 
 
-def reconcile_journal(journal: WriteIntentJournal, store) -> ReconcileReport:
+def reconcile_journal(
+    journal: WriteIntentJournal, store: "ClusterStore"
+) -> ReconcileReport:
     """Scan the journal against store truth; see module docstring.
     Never raises: a takeover must proceed (degraded, loudly) even when
     reconciliation cannot."""

@@ -51,7 +51,7 @@ class ResilientWatcher:
     def __init__(
         self,
         base_url: str,
-        kinds: tuple,
+        kinds: tuple[str, ...],
         poll_timeout: float = 5.0,
         min_backoff: float = 0.05,
         max_backoff: float = 5.0,

@@ -1,0 +1,35 @@
+"""Lock-discipline markers consumed by the static analyzer
+(kube_batch_tpu.analysis.lock_discipline).
+
+The threaded layers (cache, store, workqueue, journal, watch hub)
+follow a clone-under-mutex discipline: every attribute declared guarded
+must only be touched lexically inside ``with self.<lock>`` or in a
+method the caller promises to invoke with the lock held. Two ways to
+make that promise, both checked statically:
+
+- name the method with a ``_locked`` suffix (the convention
+  ``WatchHub._activate_locked`` already uses), or
+- decorate it with :func:`assume_locked`.
+
+``assume_locked`` is a runtime no-op — it exists so the promise is
+visible at the definition site and greppable, and so the analyzer can
+tell a deliberate lock-held helper from a forgotten ``with``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+_F = TypeVar("_F", bound=Callable)
+
+
+def assume_locked(fn: _F) -> _F:
+    """Mark ``fn`` as called only with its owner's lock already held.
+
+    The lock-discipline analyzer (KBT-L001) exempts the body; the
+    caller side remains checked — a call from an unlocked context still
+    trips on whatever guarded attribute the helper touches transitively
+    only if that caller touches one itself, so keep these helpers small
+    and truly internal (leading underscore)."""
+    fn.__assume_locked__ = True  # type: ignore[attr-defined]
+    return fn

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from kube_batch_tpu.utils.locking import assume_locked
+
 _BASE_DELAY = 0.005
 _MAX_DELAY = 1.0
 
@@ -42,6 +44,7 @@ class RateLimitingQueue:
         self._seq = 0
         self._shutdown = False
 
+    @assume_locked
     def _delay(self, key: Any) -> float:
         n = self._failures.get(key, 0)
         self._failures[key] = n + 1

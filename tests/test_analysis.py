@@ -1,0 +1,452 @@
+"""Tier-1 tests for the domain-aware static analysis suite
+(kube_batch_tpu.analysis) and the stdlib lint checks it rides with
+(hack/verify.py).
+
+Each analyzer (A1 lock-discipline, A2 JAX hazards, A3 registry
+consistency, A4 snapshot escape) is proven on a seeded-violation
+fixture — source strings with exactly the defect class the analyzer
+exists to catch — plus its negative twin (the compliant spelling must
+NOT fire). The live tree runs as a smoke: the committed baseline must
+leave zero unsuppressed findings, so `hack/verify.py` stays green.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kube_batch_tpu.analysis import (
+    SourceFile,
+    apply_baseline,
+    load_baseline,
+    load_tree,
+    run_suite,
+)
+from kube_batch_tpu.analysis import (
+    jax_hazards,
+    lock_discipline,
+    registry_consistency,
+    snapshot_escape,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def sf(path: str, source: str) -> SourceFile:
+    return SourceFile(path, source, ast.parse(source, path))
+
+
+def codes(findings) -> list[str]:
+    return [f.code for f in findings]
+
+
+# -- A1: lock discipline -----------------------------------------------------
+
+A1_FIXTURE = '''
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0        #: guarded_by _lock
+        self._items = {}     #: guarded_by _lock
+
+    def bad(self):
+        self._seq += 1       # VIOLATION: no lock held
+
+    def good(self):
+        with self._lock:
+            self._seq += 1
+            return self._items.get(1)
+
+    def _bump_locked(self):
+        self._seq += 1       # exempt: _locked suffix
+
+    @assume_locked
+    def _peek(self):
+        return self._items   # exempt: assume_locked marker
+
+    def nested_ok(self):
+        with self._lock:
+            def inner():
+                return self._seq   # lexically under the with: ok
+            return inner()
+'''
+
+
+def test_lock_discipline_fires_on_unlocked_access():
+    findings = lock_discipline.analyze([sf("kube_batch_tpu/x/hub.py", A1_FIXTURE)])
+    assert codes(findings) == ["KBT-L001"]
+    f = findings[0]
+    assert f.symbol == "Hub.bad._seq"
+    assert "_lock" in f.message
+
+
+def test_lock_discipline_seed_map_applies_to_real_paths():
+    src = (
+        "import threading\n"
+        "class RateLimitingQueue:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._heap = []\n"
+        "    def peek(self):\n"
+        "        return self._heap[0]\n"
+    )
+    findings = lock_discipline.analyze([sf("kube_batch_tpu/utils/workqueue.py", src)])
+    assert codes(findings) == ["KBT-L001"]
+    assert findings[0].symbol == "RateLimitingQueue.peek._heap"
+
+
+def test_lock_discipline_unknown_lock_annotation():
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._x = 1  #: guarded_by _mutex\n"
+    )
+    findings = lock_discipline.analyze([sf("kube_batch_tpu/x/c.py", src)])
+    assert codes(findings) == ["KBT-L002"]
+
+
+# -- A2: JAX hazards ---------------------------------------------------------
+
+A2_FIXTURE = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("flag",))
+def solve(x, flag):
+    if flag:                       # static arg: ok
+        x = x + 1
+    if x is None:                  # identity: ok (fresh/resume dispatch)
+        x = jnp.zeros(())
+    v = x.item()                   # VIOLATION J001 host sync
+    print("trace", v)              # VIOLATION J003 bare print
+    y = np.asarray(x)              # VIOLATION J001 np materialization
+    if jnp.any(x > 0):             # VIOLATION J002 truth test on traced
+        y = y + 1
+    return helper(y)
+
+def helper(y):
+    return float(y)                # VIOLATION J001 via call closure
+
+def host_pack(a):
+    return np.asarray(a).item()    # not jit-reachable: silent
+'''
+
+
+def test_jax_hazards_fire_in_jit_scope_only():
+    findings = jax_hazards.analyze([sf("kube_batch_tpu/ops/fix.py", A2_FIXTURE)])
+    got = sorted(codes(findings))
+    assert got == ["KBT-J001", "KBT-J001", "KBT-J001", "KBT-J002", "KBT-J003"]
+    # the host-side function stayed silent
+    assert not any("host_pack" in f.symbol for f in findings)
+    # the call-closure reached helper()
+    assert any(f.symbol.startswith("helper.") for f in findings)
+
+
+def test_jax_hazards_scope_is_ops_and_parallel():
+    findings = jax_hazards.analyze([sf("kube_batch_tpu/cache/fix.py", A2_FIXTURE)])
+    assert findings == []
+
+
+J004_FIXTURE = '''
+import numpy as np
+from kube_batch_tpu.api.numerics import comparison_dtype
+
+def share_bad(a, b):
+    return float(np.float64(a) / np.float64(b))   # VIOLATION x2
+
+def share_ok(a, b):
+    dt = comparison_dtype()
+    if dt is np.float64:                          # identity consult: ok
+        return a / b
+    return float(dt(a) / dt(b))
+'''
+
+
+def test_dtype_policy_fires_in_plugins_not_kernels():
+    findings = jax_hazards.analyze([sf("kube_batch_tpu/plugins/fix.py", J004_FIXTURE)])
+    # two literals on one line share (path, line, code, symbol): one finding
+    assert codes(findings) == ["KBT-J004"]
+    assert all(f.symbol.startswith("share_bad") for f in findings)
+    # kernels pin f32 by contract; out of J004 scope
+    assert jax_hazards.analyze([sf("kube_batch_tpu/ops/fix2.py", J004_FIXTURE)]) == []
+
+
+# -- A3: registry consistency ------------------------------------------------
+
+FAULTS_FIXTURE = (
+    "POINTS = (\n"
+    '    "solve.xla",\n'
+    '    "bind.write",\n'
+    '    "evict.write",\n'
+    '    "lease.renew",\n'
+    ")\n"
+)
+
+FIRER_FIXTURE = '''
+from kube_batch_tpu import faults, metrics
+
+def go(op):
+    if faults.should_fire("solve.xla"):
+        raise RuntimeError
+    if faults.should_fire(f"{op}.write"):      # wildcard: bind./evict.write
+        raise RuntimeError
+    if faults.should_fire("solve.typo"):       # VIOLATION R001
+        raise RuntimeError
+    metrics.register_fault_injection("x")
+    metrics.register_nonexistent("x")          # VIOLATION R003
+'''
+
+METRICS_FIXTURE = (
+    "def register_fault_injection(point):\n"
+    "    pass\n"
+)
+
+
+def _a3_files():
+    return [
+        sf("kube_batch_tpu/faults/__init__.py", FAULTS_FIXTURE),
+        sf("kube_batch_tpu/metrics/__init__.py", METRICS_FIXTURE),
+        sf("kube_batch_tpu/worker.py", FIRER_FIXTURE),
+    ]
+
+
+def test_registry_fault_points_both_directions(tmp_path):
+    findings = registry_consistency.analyze(
+        _a3_files(), repo=str(tmp_path), runbook="deployment/README.md"
+    )
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    # the typo fires R001; lease.renew is registered but never fired (R002)
+    assert [f.symbol for f in by_code["KBT-R001"]] == ["point:solve.typo"]
+    assert [f.symbol for f in by_code["KBT-R002"]] == ["point:lease.renew"]
+    # the f-string wildcard credited bind.write AND evict.write
+    fired_r002 = {f.symbol for f in by_code["KBT-R002"]}
+    assert "point:bind.write" not in fired_r002
+    assert "point:evict.write" not in fired_r002
+    assert [f.symbol for f in by_code["KBT-R003"]] == ["metric:register_nonexistent"]
+
+
+ENV_READER_FIXTURE = (
+    "import os\n"
+    'A = os.environ.get("KBT_ALPHA", "")\n'
+    'B = os.environ["KBT_BETA"]\n'
+    'ENV = "KBT_GAMMA"\n'
+)
+
+RUNBOOK_FIXTURE = (
+    "# runbook\n\n"
+    "| variable | default | meaning |\n"
+    "|---|---|---|\n"
+    "| `KBT_ALPHA` | off | alpha |\n"
+    "| `KBT_GAMMA` | off | gamma |\n"
+    "| `KBT_DEAD` | off | nobody reads me |\n"
+)
+
+
+def test_registry_env_table_both_directions(tmp_path):
+    (tmp_path / "deployment").mkdir()
+    (tmp_path / "deployment" / "README.md").write_text(RUNBOOK_FIXTURE)
+    files = [sf("kube_batch_tpu/knobs.py", ENV_READER_FIXTURE)]
+    findings = registry_consistency.analyze(files, repo=str(tmp_path))
+    syms = {f.code: f.symbol for f in findings}
+    assert syms.get("KBT-R004") == "env:KBT_BETA"  # read, undocumented
+    assert syms.get("KBT-R005") == "env:KBT_DEAD"  # documented, dead
+    assert len(findings) == 2  # ALPHA direct + GAMMA via ALL-CAPS const are fine
+
+
+# -- A4: snapshot escape -----------------------------------------------------
+
+A4_FIXTURE = '''
+class BadAction:
+    def execute(self, ssn):
+        for job in ssn.jobs.values():
+            for task in job.tasks.values():
+                task.node_name = "n0"          # VIOLATION S001
+        node = ssn.nodes.get("n0")
+        node.add_task(task)                    # VIOLATION S002
+
+class GoodAction:
+    def execute(self, ssn):
+        stmt = ssn.statement()
+        for job in ssn.jobs.values():
+            for task in job.tasks.values():
+                ssn.allocate(task, "n0")       # sanctioned API
+        stmt.commit()
+'''
+
+
+def test_snapshot_escape_fires_on_direct_mutation():
+    findings = snapshot_escape.analyze([sf("kube_batch_tpu/actions/fix.py", A4_FIXTURE)])
+    assert sorted(codes(findings)) == ["KBT-S001", "KBT-S002"]
+    assert {f.symbol for f in findings} == {
+        "BadAction.execute.node_name",
+        "BadAction.execute.add_task",
+    }
+
+
+def test_snapshot_escape_scope_is_plugins_and_actions():
+    findings = snapshot_escape.analyze([sf("kube_batch_tpu/framework/fix.py", A4_FIXTURE)])
+    assert findings == []
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_requires_reasons_and_flags_stale(tmp_path):
+    bl_file = tmp_path / "lint-baseline.toml"
+    bl_file.write_text(
+        "[[suppress]]\n"
+        'code = "KBT-L001"\n'
+        'path = "kube_batch_tpu/x/hub.py"\n'
+        'symbol = "Hub.bad._seq"\n'
+        'reason = "seeded fixture, intentionally kept"\n'
+        "\n"
+        "[[suppress]]\n"
+        'code = "KBT-J003"\n'
+        'path = "kube_batch_tpu/x/hub.py"\n'
+        'reason = ""\n'          # reason-less -> KBT-B001
+    )
+    bl = load_baseline(str(bl_file), str(tmp_path))
+    assert [e.code for e in bl.errors] == ["KBT-B001"]
+
+    findings = lock_discipline.analyze([sf("kube_batch_tpu/x/hub.py", A1_FIXTURE)])
+    kept, suppressed, stale = apply_baseline(findings, bl)
+    assert kept == []
+    assert len(suppressed) == 1
+    # the J003 entry matched nothing -> stale (KBT-B002)
+    assert [s.code for s in stale] == ["KBT-B002"]
+
+
+def test_baseline_unparseable_line_is_loud(tmp_path):
+    bl_file = tmp_path / "bl.toml"
+    bl_file.write_text("[[suppress]]\ncode = unquoted\n")
+    bl = load_baseline(str(bl_file), str(tmp_path))
+    assert any("unparseable" in e.message for e in bl.errors)
+
+
+# -- the stdlib lint (hack/verify.py) ---------------------------------------
+
+def _verify_mod():
+    spec = importlib.util.spec_from_file_location(
+        "kbt_hack_verify", os.path.join(REPO, "hack", "verify.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize(
+    "source,expect",
+    [
+        ("import os\n", "F401"),
+        ("try:\n    pass\nexcept:\n    pass\n", "E722"),
+        ("x = 1\nif x == None:\n    pass\n", "E711"),
+        ("x = 1\nif None == x:\n    pass\n", "E711"),  # the left-side gap
+        ("x = 1\nif None != x:\n    pass\n", "E711"),
+        ("def f(a=[]):\n    return a\n", "B006"),
+        ("s = f'no placeholder'\n", "F541"),
+    ],
+)
+def test_stdlib_lint_checks_fire(source, expect, tmp_path):
+    verify = _verify_mod()
+    lint = verify._Lint("x.py", ast.parse(source), source)
+    msgs = [m for _, m in lint.problems]
+    assert any(m.startswith(expect) for m in msgs), (source, msgs)
+
+
+def test_stdlib_lint_none_equality_not_double_counted():
+    verify = _verify_mod()
+    source = "x = 1\nif None == x == None:\n    pass\n"
+    lint = verify._Lint("x.py", ast.parse(source), source)
+    # two comparison ops, two problems — not four
+    assert [m for _, m in lint.problems if m.startswith("E711")] != []
+    assert len([m for _, m in lint.problems if m.startswith("E711")]) == 2
+
+
+def test_stdlib_lint_is_none_clean():
+    verify = _verify_mod()
+    source = "x = 1\nif x is None:\n    pass\n"
+    lint = verify._Lint("x.py", ast.parse(source), source)
+    assert lint.problems == []
+
+
+# -- live tree smoke ---------------------------------------------------------
+
+def test_live_tree_is_clean_under_committed_baseline():
+    findings = run_suite(REPO)
+    bl = load_baseline(os.path.join(REPO, "hack", "lint-baseline.toml"), REPO)
+    assert bl.errors == [], [e.message for e in bl.errors]
+    kept, suppressed, stale = apply_baseline(findings, bl)
+    assert kept == [], "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in kept
+    )
+    assert stale == [], "stale baseline entries:\n" + "\n".join(
+        f.render() for f in stale
+    )
+    # the baseline is doing real work, not vacuously empty
+    assert suppressed, "expected the committed baseline to cover known findings"
+
+
+def test_live_tree_fault_and_env_registries_fully_covered():
+    files = load_tree(REPO)
+    findings = registry_consistency.analyze(files, repo=REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_json_and_exit_codes():
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis", "--json"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout.strip().splitlines()[-1])
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert payload["suppressed"] > 0
+
+
+def test_cli_explain():
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis", "--explain", "KBT-L001"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert res.returncode == 0
+    assert "guarded" in res.stdout
+
+
+def test_cli_reasonless_baseline_entry_fails_the_gate(tmp_path):
+    bad = tmp_path / "bl.toml"
+    bad.write_text(
+        "[[suppress]]\n"
+        'code = "KBT-L001"\n'
+        'path = "kube_batch_tpu/server.py"\n'
+        'reason = ""\n'
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis", "--strict",
+         "--baseline", str(bad)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert res.returncode == 1
+    assert "KBT-B001" in res.stdout
+
+
+def test_cli_no_baseline_reports_known_intentional_findings():
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert res.returncode == 1
+    assert "KBT-" in res.stdout
