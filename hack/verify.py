@@ -25,7 +25,10 @@ Runs, in order:
 6. the chaos smoke (kube_batch_tpu.faults.smoke): one injected fault per
    subsystem — solver, native boundary, cache write, watch hub, lease
    elector — plus a seeded cache-mutation-detector violation, each
-   through a real scheduling path, asserting binds still land.
+   through a real scheduling path, asserting binds still land;
+7. the encode-cache parity smoke (python -m kube_batch_tpu.ops.encode_cache):
+   warm and 1%-node-churn encodes must be byte-identical to a fresh
+   cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on).
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
 (tests/test_faults.py + tests/test_recovery.py — fault drills, the
@@ -427,7 +430,22 @@ def main(argv: list[str] | None = None) -> int:
         print("verify: chaos smoke FAILED")
         failed = True
 
-    # 7. --chaos: the full chaos-marked suite + fsck on a seeded journal
+    # 7. encode-cache parity smoke: warm and 1%-churn encodes must be
+    # byte-identical to a fresh cold encode on a seeded snapshot
+    # (python -m kube_batch_tpu.ops.encode_cache). Runs with the cache
+    # at its default-on state — a shell override must not skew the gate.
+    env_ec = dict(env)
+    env_ec.pop("KBT_ENCODE_CACHE", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.ops.encode_cache"],
+        cwd=REPO, env=env_ec,
+    )
+    gates["encode_cache_smoke"] = {"ok": res.returncode == 0}
+    if res.returncode != 0:
+        print("verify: encode-cache parity smoke FAILED")
+        failed = True
+
+    # 8. --chaos: the full chaos-marked suite + fsck on a seeded journal
     if chaos:
         chaos_ok = run_chaos_gate(env)
         gates["chaos"] = {"ok": chaos_ok}

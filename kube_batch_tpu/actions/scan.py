@@ -45,7 +45,9 @@ class VectorScan:
         from kube_batch_tpu.actions.xla_allocate import _nodeorder_weights
         from kube_batch_tpu.ops.encode import encode_session
 
-        enc = encode_session(ssn.jobs, ssn.nodes, ssn.queues, dtype=np.float64)
+        enc = encode_session(
+            ssn.jobs, ssn.nodes, ssn.queues, dtype=np.float64, session=ssn
+        )
         self.enc = enc
         a = enc.arrays
         N = enc.n_nodes

@@ -109,6 +109,13 @@ class XlaAllocateAction(Action):
     def __init__(self, dtype=None) -> None:
         self._dtype = dtype
         self._warned_f32 = False
+        # Device-resident tensor arena (ops/encode_cache.TensorArena):
+        # persists across cycles on the registered action instance, so
+        # warm cycles upload only changed rows of the node slabs / group
+        # matrices instead of re-transferring the full tensor set.
+        from kube_batch_tpu.ops.encode_cache import TensorArena
+
+        self._arena = TensorArena()
         # Wall-clock split of the last execute() (bench.py reads this).
         self.last_timings: dict[str, float] = {}
         # Devices in the mesh the last execute() resolved (1 = single-chip);
@@ -214,6 +221,7 @@ class XlaAllocateAction(Action):
             dtype=dtype,
             drf=ssn.plugins.get("drf") if enable_drf else None,
             proportion=ssn.plugins.get("proportion") if enable_proportion else None,
+            session=ssn,
         )
         if not enc.tasks:
             return
@@ -221,6 +229,10 @@ class XlaAllocateAction(Action):
 
         w_least, w_balanced, w_aff, w_podaff = _nodeorder_weights(ssn)
         arrays = dict(enc.arrays)
+        # host-only metadata: the replay's latency stamps read it from
+        # enc.arrays — keep it out of the kernel input dict (it would
+        # ride every solve's transfer and change the jit pytree)
+        arrays.pop("task_created", None)
         arrays["w_least"] = dtype(w_least)
         arrays["w_balanced"] = dtype(w_balanced)
         arrays["w_aff"] = dtype(w_aff)
@@ -244,12 +256,30 @@ class XlaAllocateAction(Action):
 
         replay = _Replayer(ssn, enc, arrays, enable_drf, enable_proportion)
 
+        # Device-resident arena: the XLA rungs (single-chip twin and the
+        # GSPMD sharded solver) take persistent device handles — warm
+        # cycles upload only changed rows of the node slabs / group
+        # matrices. The Pallas rungs pack host-side and keep numpy. Any
+        # arena failure degrades to plain host arrays (jit's own
+        # transfer), never the cycle.
+        from kube_batch_tpu.ops import encode_cache as _encode_cache
+
+        dev_arrays = None
+        if _encode_cache.enabled():
+            try:
+                dev_arrays = self._arena.device_view(arrays, mesh=mesh)
+            except Exception:  # noqa: BLE001 -- residency is an optimization
+                log.exception("tensor arena upload failed; solving from host arrays")
+                self._arena.clear()
+                dev_arrays = None
+
         # Cycle deadline budget (recovery/budget.py), threaded from
         # run_once via the session: the solver entry receives the
         # remaining budget and every pre-dispatch boundary checks it.
         budget = getattr(ssn, "cycle_budget", None)
         solve_fn = self._make_solver(
-            arrays, enable_drf, enable_proportion, dtype, mesh, budget=budget
+            arrays, enable_drf, enable_proportion, dtype, mesh, budget=budget,
+            dev_arrays=dev_arrays,
         )
 
         t0 = _time.perf_counter()
@@ -272,9 +302,15 @@ class XlaAllocateAction(Action):
                         enc.task_reps,
                         ssn.nodes,
                         enc.node_names,
-                        arrays["pod_sc"].shape[1],
+                        np.asarray(arrays["pod_sc"]).shape[1],
                         dtype,
                     )
+                    if dev_arrays is not None:
+                        # mirror the refresh into the device view the
+                        # XLA rungs solve from
+                        dev_arrays["pod_sc"] = self._arena.upload(
+                            "pod_sc", arrays["pod_sc"], mesh=mesh
+                        )
                 state = solve_fn(s)
 
             result = result_of(state)
@@ -420,6 +456,7 @@ class XlaAllocateAction(Action):
         dtype,
         mesh=None,
         budget=None,
+        dev_arrays=None,
     ):
         """Pick the device solve: with a conf-selected multi-chip mesh,
         the GSPMD node-axis-sharded XLA kernel (parallel.ShardedSolver);
@@ -442,6 +479,12 @@ class XlaAllocateAction(Action):
         from kube_batch_tpu.ops.kernels import solve_allocate_state
 
         ladder = faults.solver_ladder
+        # The single-chip XLA twin solves from the arena's device
+        # handles when available; with a mesh the arena view is sharded
+        # for the GSPMD rung, so the single-chip fallback keeps host
+        # arrays (resharding a committed mesh array into a single-chip
+        # program is a cross-device copy jit would have to insert).
+        xla_arrays = dev_arrays if (dev_arrays is not None and mesh is None) else arrays
 
         def _with_budget(fn):
             """Solver-entry budget gate: a device solve is the cycle's
@@ -467,7 +510,7 @@ class XlaAllocateAction(Action):
                 if faults.should_fire("solve.xla"):
                     raise faults.FaultInjected("solve.xla")
                 out = solve_allocate_state(
-                    arrays, st, enable_drf=enable_drf,
+                    xla_arrays, st, enable_drf=enable_drf,
                     enable_proportion=enable_proportion,
                 )
             except Exception as e:
@@ -484,8 +527,12 @@ class XlaAllocateAction(Action):
 
             xla_sharded = None
             try:
+                # arena handles (sharded placement) when available —
+                # the solver's in_shardings match, so warm cycles skip
+                # the full host->mesh scatter
                 xla_sharded = ShardedSolver(
-                    arrays, mesh, enable_drf=enable_drf,
+                    dev_arrays if dev_arrays is not None else arrays,
+                    mesh, enable_drf=enable_drf,
                     enable_proportion=enable_proportion,
                 )
             except Exception:
@@ -763,13 +810,20 @@ class _Replayer:
         self.drf = ssn.plugins.get("drf") if enable_drf else None
         self.prop = ssn.plugins.get("proportion") if enable_prop else None
         self.node_idx = {name: i for i, name in enumerate(enc.node_names)}
-        # Row-indexed hot lookups for the bulk loop.
+        # Row-indexed hot lookups for the bulk loop. row_of is lazy: the
+        # numeric dispatch-column path never needs it, so the 200k-entry
+        # dict build is paid only on the fallback paths.
         self.task_keys = [f"{t.namespace}/{t.name}" for t in enc.tasks]
-        self.row_of = {t.uid: r for r, t in enumerate(enc.tasks)}
+        self._row_of: "Optional[dict]" = None
         self.node_by_row = [ssn.nodes[name] for name in enc.node_names]
         self.node_tasks_by_row = [n.tasks for n in self.node_by_row]
         self.replayed = 0  # assignment events already applied
         self.alloc_jobs: set[str] = set()  # jobs with >=1 Allocated event
+        # vectorized twin of alloc_jobs (job-row indexed) + the bulk
+        # replay's per-segment Allocated event log — what the dispatch
+        # barrier's numpy mask and numeric bind columns are built from
+        self._alloc_flags = np.zeros(len(enc.jobs), bool)
+        self._bulk_alloc_log: list[tuple] = []  # (rows, nrows, jrows) per segment
         # jobs that took a host-stepped (apply_immediate) event: their
         # allocated tasks may carry volume claims / binder-managed
         # volume_ready, so finish() keeps the per-task checks for them
@@ -785,6 +839,12 @@ class _Replayer:
         # replay time into every task's latency)
         self.decided_at = np.zeros(len(enc.tasks))
 
+    @property
+    def row_of(self) -> dict:
+        if self._row_of is None:
+            self._row_of = {t.uid: r for r, t in enumerate(self.enc.tasks)}
+        return self._row_of
+
     # -- one event -----------------------------------------------------------
 
     def apply_one(self, row: int, nrow: int, kind: int) -> None:
@@ -799,6 +859,7 @@ class _Replayer:
         if kind == KIND_ALLOCATED:
             ssn.cache.allocate_volumes(task, hostname)
             self.alloc_jobs.add(job.uid)
+            self._alloc_flags[self.task_job[row]] = True
         self.stepped_jobs.add(job.uid)
 
         # status index surgery == update_task_status's net effect
@@ -950,6 +1011,7 @@ class _Replayer:
         j_tot = _segment_sum(compj, res, touched_j.size, R)
         j_alloc = _segment_sum(compj[alloc], res[alloc], touched_j.size, R)
         wa = np.unique(tjob[alloc])
+        self._alloc_flags[wa] = True
         drf = self.drf
         touched_j_l = touched_j.tolist()
         jobs_t = [self.enc.jobs[jrow] for jrow in touched_j_l]
@@ -1007,6 +1069,13 @@ class _Replayer:
         counts = np.bincount(compj, minlength=touched_j.size).tolist()
         rows_a = np.ascontiguousarray(rows[order], np.int64)
         nrows_a = np.ascontiguousarray(nrows[order], np.int64)
+        alloc_a = alloc[order]
+        # log this segment's Allocated events (job-major, assign order
+        # within job — exactly the status-index insertion order) for the
+        # dispatch barrier's numeric bind-column reconstruction
+        self._bulk_alloc_log.append(
+            (rows_a[alloc_a], nrows_a[alloc_a], tjob[order][alloc_a])
+        )
         segments = None
         if self._native is not None:
             try:
@@ -1014,6 +1083,12 @@ class _Replayer:
                     raise ValueError("fault injected: native.prepass")
                 # index vectors go down as int64 buffers — no 2x200k
                 # PyLong boxing/unboxing round trip
+                # trusted=True: encode_session routes volume-carrying
+                # tasks host_only, so bulk rows are volume-free by
+                # construction and the prepass skips its per-event
+                # pod.volumes attribute read (~half of bulk_assign's
+                # cost at 400k). "task_created" marks our encoder; a
+                # custom EncodedSnapshot keeps the defensive prepass.
                 segments = self._native.bulk_assign(
                     self.enc.tasks,
                     self.task_keys,
@@ -1021,10 +1096,11 @@ class _Replayer:
                     self.enc.node_names,
                     rows_a,
                     nrows_a,
-                    alloc[order].astype(np.uint8).tobytes(),
+                    alloc_a.astype(np.uint8).tobytes(),
                     counts,
                     ALLOCATED,
                     PIPELINED,
+                    "task_created" in self.enc.arrays,
                 )
             except (ValueError, TypeError, AttributeError):
                 # ValueError: a bulk row carries volume claims (custom
@@ -1036,7 +1112,7 @@ class _Replayer:
                 segments = None
         if segments is None:
             segments = self._assign_segments_py(
-                rows_a.tolist(), nrows_a.tolist(), alloc[order].tolist(), counts
+                rows_a.tolist(), nrows_a.tolist(), alloc_a.tolist(), counts
             )
         for k, jrow in enumerate(touched_j.tolist()):
             alloc_d, pipe_d = segments[k]
@@ -1109,6 +1185,47 @@ class _Replayer:
             pos = end
             segments.append((alloc_d, pipe_d))
         return segments
+
+    def _numeric_columns(self, mask_arr, to_bind):
+        """(rows, keys, hostnames, created) for the pure-bulk dispatch
+        list, reconstructed from the replay's Allocated event log by
+        array gathers alone. Valid only when the log covers the ENTIRE
+        dispatch list (a prior action in the actions string can leave
+        Allocated tasks this encode never saw — the count check detects
+        that and the caller falls back to the per-task column pass).
+        Order matches bulk_dispatch's list: both are job-major with
+        status-index insertion order within a job."""
+        if not self._bulk_alloc_log or "task_created" not in self.enc.arrays:
+            return None
+        n_to_bind = len(to_bind)
+        if len(self._bulk_alloc_log) == 1:
+            rows_all, nrows_all, jrows_all = self._bulk_alloc_log[0]
+        else:
+            rows_all = np.concatenate([s[0] for s in self._bulk_alloc_log])
+            nrows_all = np.concatenate([s[1] for s in self._bulk_alloc_log])
+            jrows_all = np.concatenate([s[2] for s in self._bulk_alloc_log])
+        sel = mask_arr[jrows_all]
+        if int(sel.sum()) != n_to_bind:
+            return None
+        rows_b = rows_all[sel]
+        nrows_b = nrows_all[sel]
+        if len(self._bulk_alloc_log) > 1:
+            # job-major across segments, preserving per-segment (=
+            # bucket insertion) order within a job
+            order = np.argsort(jrows_all[sel], kind="stable")
+            rows_b = rows_b[order]
+            nrows_b = nrows_b[order]
+        tasks = self.enc.tasks
+        if n_to_bind and (
+            to_bind[0] is not tasks[int(rows_b[0])]
+            or to_bind[-1] is not tasks[int(rows_b[-1])]
+        ):
+            # order drift (should not happen) — take the per-task pass
+            return None
+        keys = np.asarray(self.task_keys, dtype=object)[rows_b].tolist()
+        hostnames = np.asarray(self.enc.node_names, dtype=object)[nrows_b].tolist()
+        created = np.asarray(self.enc.arrays["task_created"], np.float64)[rows_b]
+        return rows_b, keys, hostnames, created
 
     def _flush_nodes(self) -> None:
         """Fold the per-node resource deltas into NodeInfo, following
@@ -1240,6 +1357,7 @@ class _Replayer:
         # — isEnabledFor would then disable the native bulk_dispatch fast
         # path for the process lifetime at -v 0 (ADVICE r5, medium).
         debug_on = _glog.get_verbosity() >= 4
+        mask_arr = None
         if (
             not self.stepped_jobs
             and not debug_on
@@ -1250,13 +1368,13 @@ class _Replayer:
             # whole dispatch barrier is one native pass — per GANG the
             # ALLOCATED bucket moves wholesale under BINDING (dict move
             # when no bucket exists), tasks returned in dispatch order.
-            alloc_jobs = self.alloc_jobs
-            mask = bytes(
-                1
-                if (job.uid in alloc_jobs and ready_cnt_l[i] >= job_min_l[i])
-                else 0
-                for i, job in enumerate(self.enc.jobs)
+            # The gang-ready mask is one vector compare instead of a
+            # per-job Python genexpr (the replay diet, round 6).
+            jn = len(self.enc.jobs)
+            mask_arr = self._alloc_flags[:jn] & (
+                np.asarray(ready_cnt)[:jn] >= np.asarray(job_min)[:jn]
             )
+            mask = mask_arr.astype(np.uint8).tobytes()
             try:
                 if faults.should_fire("native.dispatch"):
                     raise TypeError("fault injected: native.dispatch")
@@ -1275,13 +1393,31 @@ class _Replayer:
                 ready_cnt_l, job_min_l, to_bind, pure_bulk, BINDING,
                 bind_volumes, debug_on,
             )
-        # Status flip + bind columns (rows / created / keys / hostnames)
-        # in ONE native pass over the dispatch list; Python fallback does
-        # the same in separate steps. The flip covers every dispatched
-        # task — stepped-path tasks are already BINDING, re-setting the
+        # Status flip + bind columns (rows / created / keys / hostnames).
+        # Preferred: NUMERIC reconstruction from the bulk replay's own
+        # Allocated event log — pure array gathers, no per-task dict
+        # lookups or attribute reads (replaces native finish_columns on
+        # the pure-bulk path); the flip is one native bulk_set_slot.
+        # Fallbacks: the native finish_columns single pass, then the
+        # Python per-task loop. The flip covers every dispatched task —
+        # stepped-path tasks are already BINDING, re-setting the
         # identical value is a no-op.
         rows_b = created = keys = hostnames = None
-        if to_bind:
+        if to_bind and pure_bulk is to_bind and mask_arr is not None:
+            cols = self._numeric_columns(mask_arr, to_bind)
+            if cols is not None:
+                rows_b, keys, hostnames, created = cols
+                flipped = False
+                if self._native is not None:
+                    try:
+                        self._native.bulk_set_slot(to_bind, "status", BINDING)
+                        flipped = True
+                    except (TypeError, AttributeError):
+                        pass
+                if not flipped:
+                    for task in to_bind:
+                        task.status = BINDING
+        if to_bind and rows_b is None:
             if self._native is not None and hasattr(self._native, "finish_columns"):
                 try:
                     rb, cb, keys, hostnames = self._native.finish_columns(

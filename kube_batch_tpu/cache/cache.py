@@ -71,6 +71,26 @@ from kube_batch_tpu.cache.store import (
 from kube_batch_tpu.utils.locking import assume_locked
 from kube_batch_tpu.utils.workqueue import RateLimitingQueue
 
+_encode_cache = None
+
+
+def _notify_encode_cache(kind: str, key: str) -> None:
+    """Dirty-feed hook for the incremental encoder
+    (ops/encode_cache.py): every informer event bumps the monotonic
+    store version and drops the churned object's memo entries. Lazily
+    imported — the ops package pulls jax, which cache construction must
+    not require."""
+    global _encode_cache
+    if _encode_cache is None:
+        try:
+            from kube_batch_tpu.ops import encode_cache as _ec
+        except Exception:  # noqa: BLE001 -- encoder absent: nothing to feed
+            _encode_cache = False
+            return
+        _encode_cache = _ec
+    if _encode_cache is not False:
+        _encode_cache.note_store_event(kind, key)
+
 SHADOW_POD_GROUP_KEY = "kube-batch-tpu/shadow-pod-group"
 
 
@@ -705,6 +725,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to add pod %s/%s to cache: %s", pod.namespace, pod.name, e)
                 return
+        _notify_encode_cache(PODS, pod.metadata.uid)
         log.V(3).infof("Added pod <%s/%s> to cache", pod.namespace, pod.name)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
@@ -715,6 +736,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to update pod %s/%s in cache: %s", new.namespace, new.name, e)
                 return
+        _notify_encode_cache(PODS, new.metadata.uid)
         log.V(3).infof("Updated pod <%s/%s> in cache", new.namespace, new.name)
 
     def delete_pod(self, pod: Pod) -> None:
@@ -724,6 +746,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to delete pod %s/%s from cache: %s", pod.namespace, pod.name, e)
                 return
+        _notify_encode_cache(PODS, pod.metadata.uid)
         log.V(3).infof("Deleted pod <%s/%s> from cache", pod.namespace, pod.name)
 
     # -- node handlers (reference event_handlers.go:262-370) ---------------
@@ -734,6 +757,7 @@ class SchedulerCache:
                 self.nodes[node.name].set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
+        _notify_encode_cache(NODES, node.name)
 
     def update_node(self, old: Node, new: Node) -> None:
         with self._mutex:
@@ -750,6 +774,11 @@ class SchedulerCache:
                 or old.conditions != new.conditions
             ):
                 ni.set_node(new)
+                changed = True
+            else:
+                changed = False
+        if changed:
+            _notify_encode_cache(NODES, new.name)
 
     def delete_node(self, node: Node) -> None:
         with self._mutex:
@@ -757,6 +786,7 @@ class SchedulerCache:
                 log.errorf("Failed to delete node %s: does not exist in cache", node.name)
                 return
             del self.nodes[node.name]
+        _notify_encode_cache(NODES, node.name)
 
     # -- podgroup handlers (reference event_handlers.go:372-493) -----------
 

@@ -92,6 +92,8 @@ POINTS = (
     "journal.replay",   # journal unreadable at takeover -> resync self-heal
     "reconcile.scan",   # takeover scan dies mid-way -> partial, rescheduling heals
     "cycle.overrun",    # injected wedged solve -> hard-deadline abort pre-dispatch
+    # incremental encode cache (ops/encode_cache.py)
+    "encode.cache",     # cache poisoned -> state dropped, encode runs cold
     # native extension boundary (ops/, the bulk replay)
     "native.load",      # extension unavailable for the cycle -> Python twins
     "native.prepass",   # bulk_assign prepass raises -> Python replay

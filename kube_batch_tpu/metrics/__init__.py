@@ -267,6 +267,21 @@ watch_relists = Counter(
     "Full re-lists performed by watch clients after 410-Gone, by kind",
 )
 
+# -- incremental encode cache (kube_batch_tpu.ops.encode_cache) --------------
+encode_cache_hits = Counter(
+    f"{_SUBSYSTEM}_encode_cache_hits_total",
+    "Encode-cache units (signatures, group pairs, blocks) reused verbatim",
+)
+encode_cache_invalidations = Counter(
+    f"{_SUBSYSTEM}_encode_cache_invalidations_total",
+    "Encode-cache invalidations, by reason (store kind / fault / capacity)",
+)
+encode_warm_fraction = Gauge(
+    f"{_SUBSYSTEM}_encode_warm_fraction",
+    "Fraction of the last encode's units served from the cross-cycle cache "
+    "(0 = fully cold)",
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -363,6 +378,18 @@ def register_watch_relist(kind: str) -> None:
     watch_relists.inc({"kind": kind})
 
 
+def register_encode_cache_hits(n: int) -> None:
+    encode_cache_hits.inc(by=n)
+
+
+def register_encode_cache_invalidation(reason: str, n: int = 1) -> None:
+    encode_cache_invalidations.inc({"reason": reason}, by=n)
+
+
+def set_encode_warm_fraction(fraction: float) -> None:
+    encode_warm_fraction.set(fraction)
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -423,6 +450,9 @@ def render_prometheus_text() -> str:
         stale_cycles_skipped,
         watch_snapshot_age,
         watch_relists,
+        encode_cache_hits,
+        encode_cache_invalidations,
+        encode_warm_fraction,
     ]
     lines: list[str] = []
     for metric in families:

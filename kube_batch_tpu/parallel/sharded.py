@@ -106,6 +106,12 @@ class ShardedSolver:
     gathers it to host (`np.array`), patches it, and re-enters; the jit's
     in_shardings scatter it again.
 
+    ``arrays`` may be host numpy or arena handles
+    (ops/encode_cache.TensorArena device arrays placed with this mesh's
+    shardings): pre-placed arrays already match ``in_shardings``, so
+    warm cycles skip the full host->mesh scatter and upload only the
+    rows the arena found changed.
+
     Each loop iteration evaluates feasibility + scores on the local node
     block and GSPMD inserts the cross-device argmax/select for the
     winning node (psum-style reduction over the lone sharded axis riding

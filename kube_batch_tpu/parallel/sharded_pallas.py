@@ -90,6 +90,15 @@ class ShardedPallasSolver:
         axis_name: str = AXIS_NAME,
         block_impl: Optional[str] = None,
     ) -> None:
+        # Arena handles (ops/encode_cache.TensorArena device arrays) are
+        # accepted: the block path folds its statics host-side, so any
+        # device-resident inputs are gathered to host numpy once here
+        # instead of syncing per fold.
+        if any(
+            not isinstance(v, (np.ndarray, np.generic, float, int, bool))
+            for v in arrays.values()
+        ):
+            arrays = {k: np.asarray(v) for k, v in arrays.items()}
         if np.dtype(np.asarray(arrays["task_req"]).dtype) != np.float32:
             raise ValueError(
                 "blocked sharded-Pallas solve is float32-only (like the "

@@ -8,6 +8,7 @@ import queue
 import re
 import threading
 from collections import deque
+from contextlib import contextmanager
 from typing import Optional, Union
 
 from kube_batch_tpu.apis.types import (
@@ -26,6 +27,26 @@ from kube_batch_tpu.api.job_info import TaskInfo
 from kube_batch_tpu.api.resource_info import Resource
 
 _QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+
+
+@contextmanager
+def x64_enabled(enable: bool = True):
+    """Temporarily pin ``jax_enable_x64`` through the supported
+    ``jax.config.update`` API and restore the previous value on exit.
+
+    The one place tests flip x64 mid-suite: `jax.experimental.enable_x64`
+    is a deprecated context manager slated for removal, and raw
+    env-var flips are too late once the backend initialized — this
+    helper is the single sanctioned idiom (API-drift sweep, the
+    `test_ieee_div` stale `jax.enable_x64` fix's follow-up)."""
+    import jax
+
+    old = bool(jax.config.jax_enable_x64)
+    jax.config.update("jax_enable_x64", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", old)
 
 _SUFFIX = {
     "": 1.0,

@@ -50,10 +50,10 @@ def test_f64_division_bit_exact_on_cpu_backend():
     rng = np.random.default_rng(1)
     x = rng.uniform(1e-3, 1e12, 100_000)
     y = rng.uniform(1e-3, 1e12, 100_000)
-    from jax.experimental import enable_x64
+    from kube_batch_tpu.testing import x64_enabled
 
     with jax.default_device(cpu):
-        with enable_x64():
+        with x64_enabled():
             got = np.asarray(jax.jit(ieee_div)(x, y))
     np.testing.assert_array_equal(got, x / y)
 

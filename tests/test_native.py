@@ -253,8 +253,12 @@ class TestCollectPending:
         from kube_batch_tpu.models import multi_tenant_ml
         from kube_batch_tpu.testing import FakeCache
 
+        # one world, snapshotted per encode: the clusters must be equal
+        # to the timestamp (task_created rides the arrays now)
+        fc = FakeCache(multi_tenant_ml())
+
         def enc():
-            cluster = FakeCache(multi_tenant_ml()).snapshot()
+            cluster = fc.snapshot()
             return E.encode_session(cluster.jobs, cluster.nodes, cluster.queues)
 
         a = enc()
