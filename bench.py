@@ -141,20 +141,28 @@ def percentile(sorted_vals, p):
 
 
 def timed(make_cluster, action_name: str, warm: bool, repeats: int = 2,
-          action_args=None):
+          action_args=None, compile_budget=None):
     """Warm run (jit compile at this bucket size) on a twin cluster, then
     N measured runs on fresh identical clusters. Returns
-    (best_run, sorted_times)."""
+    (best_run, sorted_times, measured_compiles). The measured runs sit
+    inside a CompileSentinel: after the warm run every repeat must hit
+    the jit cache, so ``compile_budget=0`` turns a silent recompile (a
+    shape bucket that stopped being stable, a new dict key riding the
+    input pytree) into a loud bench failure instead of a mysteriously
+    slow row."""
+    from kube_batch_tpu.analysis.trace.sentinel import CompileSentinel
+
     if warm:
         run_session(make_cluster(), action_name, action_args)
     best = None
     times = []
-    for _ in range(repeats):
-        res = run_session(make_cluster(), action_name, action_args)
-        times.append(res[0])
-        if best is None or res[0] < best[0]:
-            best = res
-    return best, sorted(times)
+    with CompileSentinel(f"bench:{action_name}", budget=compile_budget) as cs:
+        for _ in range(repeats):
+            res = run_session(make_cluster(), action_name, action_args)
+            times.append(res[0])
+            if best is None or res[0] < best[0]:
+                best = res
+    return best, sorted(times), cs.compiles
 
 
 def reclaim_cluster(n_nodes=400):
@@ -248,9 +256,15 @@ def encode_cache_row(n_tasks: int = 100_000, n_nodes: int = 10_000) -> dict:
         )
         return time.perf_counter() - t0, enc
 
+    from kube_batch_tpu.analysis.trace.sentinel import CompileSentinel
+
     ec.invalidate_all("bench")
     encode_cold_s, cold = encode()
-    encode_warm_s, warm = encode()
+    # Steady-state re-encode is pure host work riding the unit cache —
+    # budget 0: an encode that starts compiling device programs has
+    # grown a dependency the warm loop cannot afford.
+    with CompileSentinel("bench:encode_warm", budget=0) as warm_cs:
+        encode_warm_s, warm = encode()
     # 1% node churn: replace the Node object under 1% of NodeInfos
     for name in sorted(ssn.nodes)[: max(n_nodes // 100, 1)]:
         ni = ssn.nodes[name]
@@ -288,6 +302,7 @@ def encode_cache_row(n_tasks: int = 100_000, n_nodes: int = 10_000) -> dict:
         "warm_speedup": warm_speedup,
         "churn_speedup": churn_speedup,
         "warm_fraction": round(warm_fraction, 4),
+        "warm_encode_compiles": warm_cs.compiles,
         "arrays_byte_identical": True,
         "note": (
             "same-session re-encode (steady state) and 1%-node-churn "
@@ -440,15 +455,15 @@ def main() -> None:
     full_serial = os.environ.get("KBT_BENCH_FULL_SERIAL") == "1"
 
     def record(name, make_cluster, serial, sessions=5, action_args=None,
-               env=None):
+               env=None, compile_budget=None):
         saved = {}
         for k, v in (env or {}).items():
             saved[k] = os.environ.get(k)
             os.environ[k] = v
         try:
-            (xla_s, binds, t), times = timed(
+            (xla_s, binds, t), times, compiles = timed(
                 make_cluster, "xla_allocate", warm=True, repeats=sessions,
-                action_args=action_args,
+                action_args=action_args, compile_budget=compile_budget,
             )
         finally:
             for k, v in saved.items():
@@ -461,6 +476,9 @@ def main() -> None:
             "binds": len(binds),
             "sessions": sessions,
             "p50_s": round(percentile(times, 50), 4),
+            # compiles during the MEASURED repeats (the warm twin already
+            # ran): nonzero means a row is paying trace+compile, not solve
+            "measured_compiles": compiles,
         }
         if sessions >= 5:
             # tail percentiles are only honest with enough samples; a
@@ -470,7 +488,7 @@ def main() -> None:
         for k, v in t.items():
             entry[k] = round(v, 4)
         if serial == "live" or (serial == "cached" and full_serial):
-            (serial_s, s_binds, _), _ = timed(
+            (serial_s, s_binds, _), _, _ = timed(
                 make_cluster, "allocate", warm=False, repeats=1
             )
             entry["serial_s"] = round(serial_s, 4)
@@ -500,7 +518,12 @@ def main() -> None:
     # (~26 min), so this row is the standing at-scale honesty check
     # (~2.5 min serial at ~6us/pair).
     record("preempt_25k_1k", lambda: preempt_mix(25_000, 1000), serial="live")
-    e50k = record("preempt_50k_5k", lambda: preempt_mix(50_000, 5000), serial="cached")
+    # The headline row pins its compile budget: after the warm twin, the
+    # 5 measured 50k×5k sessions must not compile anything (ISSUE 7 —
+    # CompileSentinel raises on a silent recompile instead of letting it
+    # masquerade as solver regression).
+    e50k = record("preempt_50k_5k", lambda: preempt_mix(50_000, 5000),
+                  serial="cached", compile_budget=0)
     record("multi_tenant_ml", lambda: multi_tenant_ml(), serial="live")
     # Scale headroom rows (SURVEY section 8's 100k claim + the v5e
     # VMEM-budget envelope at 4x the reference's headline, measured):

@@ -14,9 +14,13 @@ Runs, in order:
    (F541);
 4. the domain-aware analysis suite (python -m kube_batch_tpu.analysis):
    lock-discipline (KBT-L*), JAX hazards (KBT-J*), registry consistency
-   (KBT-R*), snapshot escape (KBT-S*), against the committed
-   hack/lint-baseline.toml (reason-less entries always fail; stale
-   entries fail under ``--strict``);
+   (KBT-R*), snapshot escape (KBT-S*), lock-order/deadlock (KBT-D*),
+   against the committed hack/lint-baseline.toml (reason-less entries
+   always fail; stale entries fail under ``--strict``), then the
+   trace-level program auditor (python -m kube_batch_tpu.analysis.trace,
+   KBT-P*: jaxpr callbacks, f64 leaks, captured constants, donation,
+   cross-tier signature drift) under JAX_PLATFORMS=cpu against
+   hack/trace-baseline.toml;
 5. ruff + mypy when importable (CI images that carry them get the full
    gate; their absence degrades to the stdlib checks, loudly — unless
    ``--strict``, which makes a missing tool a FAILURE, so an image
@@ -328,6 +332,45 @@ def run_analysis_gate(strict: bool) -> dict:
     }
 
 
+def run_trace_gate(strict: bool) -> dict:
+    """The jaxpr-level trace auditor (python -m
+    kube_batch_tpu.analysis.trace) under JAX_PLATFORMS=cpu. Same
+    contract as the AST suite gate; per-code counts ride the --json
+    summary. Unlike every other gate this one traces the real solver
+    programs, so it runs last among the analysis gates (a broken
+    kernel fails here with a traceback, not a lint)."""
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis.trace", "--json"]
+        + (["--strict"] if strict else []),
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    summary: dict = {"ok": False, "counts": {}}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: trace audit produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and summary.get("ok", False)
+    if not ok:
+        for f in summary.get("findings", []) + summary.get("baseline_errors", []):
+            print(f"{f['path']}:{f['line']}: {f['code']} {f['message']}")
+        if strict:
+            for f in summary.get("stale", []):
+                print(f"{f['path']}:{f['line']}: {f['code']} {f['message']}")
+        print("verify: trace audit FAILED "
+              "(python -m kube_batch_tpu.analysis.trace --explain CODE)")
+    return {
+        "ok": ok,
+        "counts": summary.get("counts", {}),
+        "suppressed": summary.get("suppressed", 0),
+        "entries": summary.get("entries", {}),
+        "stale": len(summary.get("stale", [])),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     import json
 
@@ -383,6 +426,13 @@ def main(argv: list[str] | None = None) -> int:
     # baseline entries)
     gates["analysis"] = run_analysis_gate(strict)
     if not gates["analysis"]["ok"]:
+        failed = True
+
+    # 4b. the trace-level program auditor (KBT-P0xx): jaxpr lints +
+    # donation + cross-tier signature drift over the real solver entry
+    # points, on abstract inputs under JAX_PLATFORMS=cpu
+    gates["trace_audit"] = run_trace_gate(strict)
+    if not gates["trace_audit"]["ok"]:
         failed = True
 
     # 5. the full generic gate, when available (mypy beyond api/ per

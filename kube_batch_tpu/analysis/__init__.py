@@ -29,6 +29,19 @@ stdlib-only (ast-based) so the bare container runs the full gate:
 - **A4 snapshot escape** (:mod:`.snapshot_escape`, KBT-S0xx):
   plugins/actions that mutate objects reached from a session snapshot
   without going through the Statement / session APIs.
+- **A5 lock order** (:mod:`.lock_order`, KBT-D0xx): the interprocedural
+  lock-acquisition graph over the threaded layers (built on A1's
+  guarded-by seed map) — ABBA cycles and blocking calls (fsync, sleep,
+  subprocess, device sync) inside lock-held regions.
+
+A jax-dependent sibling, the **trace-time auditor**
+(:mod:`kube_batch_tpu.analysis.trace`, KBT-P0xx, its own CLI
+``python -m kube_batch_tpu.analysis.trace``), traces the real solver
+entry points on abstract inputs and audits the resulting jaxprs /
+lowered programs: host callbacks and warm-cycle transfers, f64 upcast
+leaks, large captured constants, un-honored donation, and cross-tier
+program-signature drift. It shares this package's Finding/CODES/
+baseline machinery; this module stays stdlib-only.
 
 Findings print as ``file:line: CODE message``. Intentional deviations
 live in a committed suppression file (``hack/lint-baseline.toml``);
@@ -54,6 +67,7 @@ __all__ = [
     "load_tree",
     "load_baseline",
     "apply_baseline",
+    "render_baseline",
     "run_suite",
     "repo_root",
 ]
@@ -200,6 +214,81 @@ CODES: dict[str, tuple[str, str]] = {
         "Same failure class as KBT-S001: no undo log, no events, shares "
         "desync.",
     ),
+    "KBT-D001": (
+        "lock-order cycle (ABBA) in the static acquisition graph",
+        "Two locks are acquired in opposite orders on different code "
+        "paths (A then B here, B then A elsewhere) in the threaded "
+        "cache/store/workqueue/journal/watch-hub layers. Under load the "
+        "two paths interleave and deadlock — the failover takeover path "
+        "is exactly where both orders tend to meet. Fix: pick one global "
+        "order (document it where the locks are declared) and re-nest the "
+        "inner acquisition, or split the critical section so the second "
+        "lock is taken after the first is released.",
+    ),
+    "KBT-D002": (
+        "blocking call while holding a lock",
+        "A lock-held region calls into a blocking API (journal fsync, "
+        "time.sleep, subprocess, future .result(), device sync like "
+        "block_until_ready/device_get, network send/recv). Every other "
+        "thread needing that lock — watch emitters, resync workers, the "
+        "HTTP handlers — stalls for the full blocking latency, and a "
+        "hung fsync or RPC turns into a scheduler-wide freeze. Fix: move "
+        "the blocking work outside the critical section (snapshot under "
+        "the lock, block after), or baseline with the ordering argument "
+        "when the blocking is the point (e.g. WAL fsync ordered with seq "
+        "assignment). `Condition.wait` on the held condition is exempt — "
+        "it releases the lock while blocking.",
+    ),
+    "KBT-P001": (
+        "host callback / transfer inside a traced solver program",
+        "The traced program for a solver entry point contains a host "
+        "callback primitive (pure_callback/io_callback/debug_callback) "
+        "or fails the warm-cycle transfer guard (an implicit host<->device "
+        "transfer on a steady-state cycle). On TPU each one serializes "
+        "the solve pipeline per iteration — the exact per-decision cost "
+        "the resident-state design exists to avoid. Fix: keep the data "
+        "device-resident (arena), hoist host work outside the jitted "
+        "entry, or make the value a static argument.",
+    ),
+    "KBT-P002": (
+        "f64 upcast leaked into a traced solver program",
+        "An intermediate value in the traced program carries float64 "
+        "while the entry point's inputs are float32 — a silent upcast "
+        "(Python float promotion, np.float64 constant, dtype-less "
+        "jnp.asarray) that doubles VMEM pressure and splits numerics "
+        "from the f32 kernels the parity suites pin. The source-level "
+        "KBT-J004 only sees literal spellings; this check sees the "
+        "traced truth. Fix: pin the constant/cast to the array's dtype.",
+    ),
+    "KBT-P003": (
+        "large host constant captured into a traced program",
+        "The traced program closes over a host constant bigger than the "
+        "audit threshold — an embedded table re-uploaded and re-hashed "
+        "on every compile (the 400k-row-table footgun). Large data must "
+        "enter as a traced argument (cacheable, arena-resident), not a "
+        "captured constant. Fix: pass it as an argument or pre-place it "
+        "on device.",
+    ),
+    "KBT-P004": (
+        "declared buffer donation is not honored",
+        "An entry point declares donate_argnums but the lowered program "
+        "carries no input-output alias for the donated buffer (no "
+        "shape/dtype-matching output, or XLA dropped the alias) — the "
+        "arena's in-place row scatter silently becomes a full copy and "
+        "device memory doubles at the biggest buffer. Fix: make the "
+        "donated input's aval match an output aval exactly, or drop the "
+        "donation declaration so the copy is at least explicit.",
+    ),
+    "KBT-P005": (
+        "cross-tier program signature drift",
+        "The solver tiers (XLA twin, GSPMD sharded rung, mesh-Pallas "
+        "rung) disagree on an input/output aval (shape or dtype) of the "
+        "shared SolveState protocol at some mesh size. The degradation "
+        "ladder hands state between tiers mid-session — a drifted field "
+        "means resume-after-failover reinterprets bits or retraces, and "
+        "selection numerics diverge structurally between tiers. Fix: "
+        "restore the drifted field's shape/dtype in the offending tier.",
+    ),
     "KBT-B001": (
         "baseline entry missing a reason",
         "Every hack/lint-baseline.toml entry must say WHY the finding is "
@@ -267,6 +356,9 @@ class Suppression:
     reason: str = ""
     line: int = 0  # line of the [[suppress]] header in the baseline
     hits: int = 0  # findings matched this run
+    # the entry's verbatim lines (header + pairs + trailing comments), so
+    # --prune can rewrite the file preserving formatting and reasons
+    raw: list[str] = field(default_factory=list)
 
     def matches(self, f: Finding) -> bool:
         return (
@@ -281,6 +373,7 @@ class Baseline:
     path: str
     suppressions: list[Suppression] = field(default_factory=list)
     errors: list[Finding] = field(default_factory=list)  # KBT-B001 + parse errors
+    preamble: list[str] = field(default_factory=list)  # verbatim lines before the first entry
 
 
 def _strip_comment(line: str) -> str:
@@ -308,15 +401,19 @@ def load_baseline(path: str, repo: str) -> Baseline:
         for lineno, raw in enumerate(fh, 1):
             line = _strip_comment(raw)
             if not line:
+                if cur is None:
+                    bl.preamble.append(raw.rstrip("\n"))
                 continue
             if _HEADER_RE.match(line):
                 cur = Suppression(line=lineno)
+                cur.raw.append(raw.rstrip("\n"))
                 bl.suppressions.append(cur)
                 continue
             m = _PAIR_RE.match(line)
             if m and cur is not None and m.group("key") in _KEYS:
                 val = m.group("val").replace('\\"', '"').replace("\\\\", "\\")
                 setattr(cur, m.group("key"), val)
+                cur.raw.append(raw.rstrip("\n"))
                 continue
             bl.errors.append(
                 Finding(
@@ -380,6 +477,21 @@ def apply_baseline(
     return kept, suppressed, stale
 
 
+def render_baseline(bl: Baseline, keep: list[Suppression]) -> str:
+    """The baseline file's text with only ``keep`` entries, preserving
+    the preamble comment block and each entry's verbatim lines/order
+    (the --prune rewrite)."""
+    parts: list[str] = []
+    preamble = list(bl.preamble)
+    while preamble and not preamble[-1].strip():
+        preamble.pop()
+    if preamble:
+        parts.append("\n".join(preamble))
+    for s in keep:
+        parts.append("\n".join(s.raw))
+    return "\n\n".join(parts) + "\n" if parts else ""
+
+
 # -- suite -------------------------------------------------------------------
 
 
@@ -394,6 +506,7 @@ def run_suite(
     from kube_batch_tpu.analysis import (
         jax_hazards,
         lock_discipline,
+        lock_order,
         registry_consistency,
         snapshot_escape,
     )
@@ -404,6 +517,7 @@ def run_suite(
     findings: list[Finding] = []
     analyzers: list[Callable[..., list[Finding]]] = [
         lock_discipline.analyze,
+        lock_order.analyze,
         jax_hazards.analyze,
         snapshot_escape.analyze,
     ]

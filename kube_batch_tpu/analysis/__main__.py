@@ -5,12 +5,15 @@ Usage::
     python -m kube_batch_tpu.analysis [--json] [--strict]
                                       [--baseline PATH] [--no-baseline]
                                       [--repo PATH] [--explain CODE]
+                                      [--prune]
 
 Exit codes: 0 clean (every finding suppressed with a reason), 1 findings
 or baseline problems, 2 usage error. ``--strict`` additionally fails on
 stale baseline entries (KBT-B002), so the committed baseline can only
 shrink. ``--explain CODE`` prints what a code protects and how to fix
-it, then exits.
+it, then exits. ``--prune`` rewrites the baseline in place with the
+stale entries removed (verbatim preamble/reasons/order preserved), the
+mechanical half of the only-shrinks policy.
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ from kube_batch_tpu.analysis import (
     CODES,
     apply_baseline,
     load_baseline,
+    render_baseline,
     repo_root,
     run_suite,
 )
@@ -46,6 +50,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--repo", default=None, help="tree to analyze (default: auto)")
     p.add_argument("--explain", metavar="CODE", default=None,
                    help="describe a finding code and exit")
+    p.add_argument("--prune", action="store_true",
+                   help="rewrite the baseline dropping stale (KBT-B002) "
+                   "entries; reasons, ordering and the preamble comment "
+                   "block are preserved verbatim")
     try:
         args = p.parse_args(argv)
     except SystemExit as e:
@@ -72,6 +80,26 @@ def main(argv: list[str] | None = None) -> int:
         bl = load_baseline(bl_path, repo)
         kept, suppressed, stale = apply_baseline(findings, bl)
         baseline_errors = bl.errors
+
+    if args.prune:
+        if args.no_baseline:
+            print("--prune is meaningless with --no-baseline")
+            return 2
+        # Keep every entry that matched a finding this run, plus
+        # incomplete entries (they fail as KBT-B001 — deleting them would
+        # hide the error instead of fixing it). Drop exactly the stale set.
+        keep = [s for s in bl.suppressions
+                if s.hits > 0 or not (s.code and s.path)]
+        dropped = [s for s in bl.suppressions if s not in keep]
+        if dropped:
+            with open(bl_path, "w", encoding="utf-8") as fh:
+                fh.write(render_baseline(bl, keep))
+        for s in dropped:
+            print(f"pruned: {s.code} at {s.path}"
+                  + (f" ({s.symbol})" if s.symbol else ""))
+        print(f"prune: {len(dropped)} stale entr{'y' if len(dropped) == 1 else 'ies'} "
+              f"dropped, {len(keep)} kept")
+        stale = []  # just removed; don't also fail on them
 
     failing = list(kept) + list(baseline_errors)
     if args.strict:

@@ -377,7 +377,11 @@ def analyze(files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for sf in files:
         in_kernels = sf.path.startswith(
-            ("kube_batch_tpu/ops/", "kube_batch_tpu/parallel/")
+            # recovery/ is jit-adjacent since the takeover path learned to
+            # re-enter warm solves (PR 3); encode_cache and sharded_pallas
+            # live under ops//parallel/ and are covered by the prefixes.
+            ("kube_batch_tpu/ops/", "kube_batch_tpu/parallel/",
+             "kube_batch_tpu/recovery/")
         )
         in_policy = sf.path.startswith(
             ("kube_batch_tpu/plugins/", "kube_batch_tpu/api/")

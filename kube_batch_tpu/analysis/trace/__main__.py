@@ -1,0 +1,149 @@
+"""CLI for the trace-level program auditor (KBT-P0xx).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis.trace \
+        [--json] [--strict] [--baseline PATH] [--no-baseline]
+        [--explain CODE] [--mesh 1,2,4,8] [--const-bytes N]
+        [--no-transfer-check]
+
+Same exit-code contract and baseline machinery as the AST suite
+(``python -m kube_batch_tpu.analysis``), but a separate baseline file
+(default ``<repo>/hack/trace-baseline.toml``) — the two gates run
+independently, so sharing one file would mark each other's suppressions
+stale. Unlike the AST suite this imports jax and traces the real solver
+programs; run it under ``JAX_PLATFORMS=cpu`` in CI. The process forces
+``--xla_force_host_platform_device_count=8`` so the mesh rungs have
+devices to trace against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import textwrap
+
+from kube_batch_tpu.analysis import (
+    CODES,
+    apply_baseline,
+    load_baseline,
+    repo_root,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_batch_tpu.analysis.trace",
+        description="jaxpr-level auditor for the solver entry points "
+        "(callbacks, f64 leaks, captured constants, donation, "
+        "cross-tier signature drift)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable summary")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries")
+    p.add_argument("--baseline", default=None,
+                   help="suppression file (default: <repo>/hack/trace-baseline.toml)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report raw findings, apply no suppressions")
+    p.add_argument("--repo", default=None,
+                   help="repo root for the baseline path (default: auto)")
+    p.add_argument("--explain", metavar="CODE", default=None,
+                   help="describe a finding code and exit")
+    p.add_argument("--mesh", default="1,2,4,8",
+                   help="comma-separated mesh sizes to trace (default: 1,2,4,8)")
+    p.add_argument("--const-bytes", type=int, default=None,
+                   help="KBT-P003 captured-constant threshold (default: 1 MiB)")
+    p.add_argument("--no-transfer-check", action="store_true",
+                   help="skip the runtime transfer_guard warm-cycle check "
+                   "(no compile, trace-only)")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    if args.explain:
+        code = args.explain.upper()
+        if code not in CODES:
+            print(f"unknown code {code!r}; known: {', '.join(sorted(CODES))}")
+            return 2
+        title, body = CODES[code]
+        print(f"{code}: {title}\n")
+        print(textwrap.fill(body, width=78))
+        return 0
+
+    # The mesh rungs need 8 host devices; set before jax loads (jax is
+    # imported lazily inside run_trace_audit).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from kube_batch_tpu.analysis.trace import (
+        CONST_BYTES_DEFAULT,
+        run_trace_audit,
+    )
+
+    try:
+        mesh_sizes = tuple(int(x) for x in args.mesh.split(",") if x.strip())
+    except ValueError:
+        print(f"bad --mesh value {args.mesh!r}")
+        return 2
+
+    findings, info = run_trace_audit(
+        mesh_sizes=mesh_sizes,
+        const_bytes=args.const_bytes or CONST_BYTES_DEFAULT,
+        transfer_check=not args.no_transfer_check,
+    )
+
+    repo = os.path.abspath(args.repo) if args.repo else repo_root()
+    if args.no_baseline:
+        kept, suppressed, stale, baseline_errors = findings, [], [], []
+    else:
+        bl_path = args.baseline or os.path.join(repo, "hack", "trace-baseline.toml")
+        bl = load_baseline(bl_path, repo)
+        kept, suppressed, stale = apply_baseline(findings, bl)
+        baseline_errors = bl.errors
+
+    failing = list(kept) + list(baseline_errors)
+    if args.strict:
+        failing += stale
+
+    if args.json:
+        print(json.dumps({
+            "ok": not failing,
+            "repo": repo,
+            "findings": [f.__dict__ for f in kept],
+            "baseline_errors": [f.__dict__ for f in baseline_errors],
+            "stale": [f.__dict__ for f in stale],
+            "suppressed": len(suppressed),
+            "counts": _counts(kept),
+            "entries": info["entries"],
+            "mesh_sizes": info["mesh_sizes"],
+        }, sort_keys=True))
+    else:
+        for f in sorted(failing, key=lambda f: (f.path, f.line, f.code)):
+            print(f.render())
+        if stale and not args.strict:
+            for f in stale:
+                print(f"note: {f.render()}")
+        print(
+            f"trace audit: {len(info['entries'])} program(s) traced "
+            f"(mesh {info['mesh_sizes']}), {len(kept)} finding(s), "
+            f"{len(baseline_errors)} baseline error(s), "
+            f"{len(stale)} stale suppression(s), {len(suppressed)} suppressed"
+        )
+    return 1 if failing else 0
+
+
+def _counts(findings) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for f in findings:
+        out[f.code] = out.get(f.code, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,7 +151,11 @@ def _share_rows(alloc, denom, dims):
     share(alloc, denom) with 0/0 -> 0, x/0 -> 1 (helpers.go:43-60,
     drf.go:161-171, proportion.go:211-223)."""
     safe = jnp.where(denom == 0, 1.0, denom)
-    s = jnp.where(denom == 0, jnp.where(alloc == 0, 0.0, 1.0), ieee_div(alloc, safe))
+    # dtype-pinned 0/1 branch: a two-python-scalar where takes the default
+    # float dtype, which upcasts the share matrix to f64 under x64
+    # (trace-audit KBT-P002)
+    zero_denom = (alloc != 0).astype(alloc.dtype)
+    s = jnp.where(denom == 0, zero_denom, ieee_div(alloc, safe))
     s = jnp.where(dims, s, -jnp.inf)
     return jnp.maximum(jnp.max(s, axis=-1), 0.0)
 

@@ -567,7 +567,8 @@ def _build(
                 alloc_r = jalloc_ref[r, :, :]
                 sr = jnp.where(
                     denom == 0.0,
-                    jnp.where(alloc_r == 0.0, 0.0, 1.0),
+                    # dtype-pinned 0/1 branch (trace-audit KBT-P002)
+                    (alloc_r != 0.0).astype(alloc_r.dtype),
                     _ieee_div(alloc_r, jnp.where(denom == 0.0, 1.0, denom)),
                 )
                 s = jnp.where(drfd_ref[r] != 0, jnp.maximum(s, sr), s)
@@ -580,7 +581,8 @@ def _build(
                 al = qalloc_ref[r, :, :]
                 sr = jnp.where(
                     d == 0.0,
-                    jnp.where(al == 0.0, 0.0, 1.0),
+                    # dtype-pinned 0/1 branch (trace-audit KBT-P002)
+                    (al != 0.0).astype(al.dtype),
                     _ieee_div(al, jnp.where(d == 0.0, 1.0, d)),
                 )
                 s = jnp.where(qdim_ref[r, :, :] != 0.0, jnp.maximum(s, sr), s)
@@ -1152,19 +1154,19 @@ class PallasSolver:
 
     _AFFW_IDX = 9  # affw's position in _Packed.statics
 
-    def solve(self, state: SolveState | None = None) -> SolveState:
-        p = self.packed
-        Tr, Nr, Jr, Qr, Cr, GT, R, max_iter = p.dims
-        if self.a.get("pod_sc") is not self._pod_sc:
-            # The action recomputed live InterPodAffinity scores after a
-            # host-stepped pod landed (VERDICT r3 item 7): re-fold just
-            # the affinity static and resume with the fresh scores.
-            self._pod_sc = self.a.get("pod_sc")
-            p.statics[self._AFFW_IDX] = fold_affinity_scores(self.a, Nr)
-        f32, i32 = np.float32, np.int32
+    def trace_args(self, state: SolveState | None = None) -> tuple:
+        """The concrete argument tuple ``solve`` passes to the traced
+        program ``self.fn``. Public so the trace auditor
+        (analysis/trace) can walk the fused kernel's jaxpr on these
+        arguments' avals without executing it."""
         if state is None:
             state = _initial_state(self.a, self.enable_drf, self.enable_proportion)
+        return self._program_args(state)
 
+    def _program_args(self, state: SolveState) -> tuple:
+        p = self.packed
+        Tr, Nr, Jr, Qr, Cr, GT, R, max_iter = p.dims
+        f32, i32 = np.float32, np.int32
         job_active = np.asarray(state.job_active, bool)
         job_queue = np.asarray(self.a["job_queue"], np.int64)
         qcount = np.bincount(
@@ -1199,7 +1201,20 @@ class PallasSolver:
             _fold2(np.asarray(state.q_alloc, f32), Qr, f32),
             _fold1(np.asarray(state.q_alloc_has_sc, i32), Qr, i32),
         ]
-        icat_d, fcat_d = self.fn(*p.statics, iscal, *folded_state)
+        return (*p.statics, iscal, *folded_state)
+
+    def solve(self, state: SolveState | None = None) -> SolveState:
+        p = self.packed
+        Tr, Nr, Jr, Qr, Cr, GT, R, max_iter = p.dims
+        if self.a.get("pod_sc") is not self._pod_sc:
+            # The action recomputed live InterPodAffinity scores after a
+            # host-stepped pod landed (VERDICT r3 item 7): re-fold just
+            # the affinity static and resume with the fresh scores.
+            self._pod_sc = self.a.get("pod_sc")
+            p.statics[self._AFFW_IDX] = fold_affinity_scores(self.a, Nr)
+        if state is None:
+            state = _initial_state(self.a, self.enable_drf, self.enable_proportion)
+        icat_d, fcat_d = self.fn(*self._program_args(state))
         icat = np.asarray(icat_d)  # ONE round-trip for everything integer
 
         TL, NL, JL, QL = Tr * LANES, Nr * LANES, Jr * LANES, Qr * LANES

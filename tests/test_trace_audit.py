@@ -1,0 +1,282 @@
+"""Tier-1 tests for the trace-level program auditor
+(kube_batch_tpu.analysis.trace) and the compile-cache sentinel.
+
+Each KBT-P code is proven on a seeded fixture — a tiny program carrying
+exactly the defect the check exists to catch — plus its negative twin
+(the compliant spelling must NOT fire). The sentinel is proven against
+a deliberate recompile storm (shape-keyed jit churn) and against the
+warm loop it must certify. The acceptance-critical budget — zero
+recompiles across three consecutive warm cycles — is pinned here for
+the XLA twin and the GSPMD sharded rung on the real solver programs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_batch_tpu.analysis import apply_baseline, load_baseline  # noqa: E402
+from kube_batch_tpu.analysis import trace  # noqa: E402
+from kube_batch_tpu.analysis.trace import (  # noqa: E402
+    build_snapshot,
+    check_callbacks,
+    check_donation,
+    check_f64,
+    check_large_consts,
+    check_signature_drift,
+)
+from kube_batch_tpu.analysis.trace.sentinel import (  # noqa: E402
+    CompileBudgetExceeded,
+    CompileSentinel,
+)
+from kube_batch_tpu.testing import x64_enabled  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(findings) -> list[str]:
+    return [f.code for f in findings]
+
+
+@pytest.fixture(scope="module")
+def snapshot():
+    return build_snapshot()
+
+
+# -- KBT-P001: host callbacks ------------------------------------------------
+
+
+def test_p001_pure_callback_fires():
+    def host_hop(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+
+    closed = jax.make_jaxpr(host_hop)(jnp.ones((4,), jnp.float32))
+    findings = check_callbacks(closed, "fix", "kube_batch_tpu/ops/fix.py")
+    assert codes(findings) == ["KBT-P001"]
+    assert findings[0].symbol == "fix.callback.pure_callback"
+
+
+def test_p001_pure_device_program_clean():
+    closed = jax.make_jaxpr(lambda x: jnp.sin(x) * 2)(jnp.ones((4,), jnp.float32))
+    assert check_callbacks(closed, "fix", "p") == []
+
+
+def test_p001_callback_found_through_jit_nesting():
+    @jax.jit
+    def inner(x):
+        jax.debug.print("x={x}", x=x)
+        return x
+
+    closed = jax.make_jaxpr(lambda x: inner(x) + 1)(jnp.ones((4,), jnp.float32))
+    findings = check_callbacks(closed, "fix", "p")
+    assert codes(findings) == ["KBT-P001"]
+
+
+# -- KBT-P002: f64 upcast with f32 inputs ------------------------------------
+
+
+def test_p002_default_dtype_where_leaks_f64_under_x64():
+    # the exact leak pattern scrubbed out of the live kernels: a
+    # two-python-scalar where takes the x64 default dtype
+    def leak(x):
+        return jnp.where(x == 0, 0.0, 1.0)
+
+    with x64_enabled():
+        closed = jax.make_jaxpr(leak)(jax.ShapeDtypeStruct((4,), np.float32))
+    findings = check_f64(closed, "fix", "kube_batch_tpu/ops/fix.py")
+    assert codes(findings) == ["KBT-P002"]
+    assert findings[0].symbol == "fix.f64"
+
+
+def test_p002_dtype_pinned_twin_clean():
+    def pinned(x):
+        return jnp.where(x == 0, (x != 0).astype(x.dtype), x)
+
+    with x64_enabled():
+        closed = jax.make_jaxpr(pinned)(jax.ShapeDtypeStruct((4,), np.float32))
+    assert check_f64(closed, "fix", "p") == []
+
+
+def test_p002_deliberate_f64_inputs_exempt():
+    with x64_enabled():
+        closed = jax.make_jaxpr(lambda x: x * 0.5)(
+            jax.ShapeDtypeStruct((4,), np.float64)
+        )
+    assert check_f64(closed, "fix", "p") == []
+
+
+# -- KBT-P003: large captured host constants ---------------------------------
+
+
+def test_p003_large_captured_constant_fires():
+    table = np.zeros((300_000,), np.float32)  # 1.14 MiB > the 1 MiB default
+
+    closed = jax.make_jaxpr(lambda x: (x + table).sum())(jnp.float32(0))
+    findings = check_large_consts(closed, "fix", "kube_batch_tpu/ops/fix.py")
+    assert codes(findings) == ["KBT-P003"]
+    assert findings[0].symbol == "fix.const.300000"
+    assert "KiB" in findings[0].message
+
+
+def test_p003_small_constant_clean():
+    small = np.zeros((8,), np.float32)
+    closed = jax.make_jaxpr(lambda x: (x + small).sum())(jnp.float32(0))
+    assert check_large_consts(closed, "fix", "p") == []
+
+
+def test_p003_threshold_is_configurable():
+    table = np.zeros((1024,), np.float32)
+    closed = jax.make_jaxpr(lambda x: (x + table).sum())(jnp.float32(0))
+    assert codes(check_large_consts(closed, "fix", "p", const_bytes=1024)) == [
+        "KBT-P003"
+    ]
+
+
+# -- KBT-P004: donation declared but not honored -----------------------------
+
+
+def test_p004_unhonorable_donation_fires():
+    # donating the input of a reduction: no output shares its layout, so
+    # XLA cannot alias and jax warns
+    bad = jax.jit(lambda b: b.sum(), donate_argnums=(0,))
+    buf = jax.ShapeDtypeStruct((128, 2), np.float32)
+    findings = check_donation(bad, (buf,), "fix", "kube_batch_tpu/ops/fix.py")
+    assert codes(findings) == ["KBT-P004"]
+    assert findings[0].symbol == "fix.donation"
+
+
+def test_p004_honored_scatter_donation_clean():
+    # the arena row-scatter shape: output aliases the donated buffer
+    good = jax.jit(lambda b, i, v: b.at[i].set(v), donate_argnums=(0,))
+    buf = jax.ShapeDtypeStruct((128, 2), np.float32)
+    idx = jax.ShapeDtypeStruct((4,), np.int32)
+    vals = jax.ShapeDtypeStruct((4, 2), np.float32)
+    assert check_donation(good, (buf, idx, vals), "fix", "p") == []
+
+
+# -- KBT-P005: cross-tier signature drift ------------------------------------
+
+
+def test_p005_signature_drift_fires_per_field():
+    ref = {"it": ((), "int32"), "idle": ((128, 2), "float32")}
+    other = {"it": ((), "int64"), "idle": ((128, 2), "float32")}
+    findings = check_signature_drift(ref, other, "xla_twin", "mesh@2", "p")
+    assert codes(findings) == ["KBT-P005"]
+    assert findings[0].symbol == "mesh@2.drift.it"
+
+
+def test_p005_missing_field_counts_as_drift_both_ways():
+    ref = {"it": ((), "int32")}
+    assert codes(check_signature_drift(ref, {}, "a", "b", "p")) == ["KBT-P005"]
+    assert codes(check_signature_drift({}, ref, "a", "b", "p")) == ["KBT-P005"]
+
+
+def test_p005_identical_signatures_clean():
+    ref = {"it": ((), "int32"), "idle": ((128, 2), "float32")}
+    assert check_signature_drift(ref, dict(ref), "a", "b", "p") == []
+
+
+# -- compile sentinel --------------------------------------------------------
+
+
+def test_sentinel_counts_a_seeded_recompile_storm():
+    f = jax.jit(lambda x: x * 2 + 1)
+    xs = [jnp.ones((n,), jnp.float32) for n in (3, 5, 7, 9)]
+    with CompileSentinel("storm") as cs:
+        for x in xs:
+            jax.block_until_ready(f(x))
+    # every distinct shape is a fresh backend compile
+    assert cs.compiles >= len(xs)
+
+
+def test_sentinel_budget_zero_raises_on_churn():
+    f = jax.jit(lambda x: x - 3)
+    xs = [jnp.ones((n,), jnp.float32) for n in (11, 13)]
+    with pytest.raises(CompileBudgetExceeded, match="retracing"):
+        with CompileSentinel("storm", budget=0):
+            for x in xs:
+                jax.block_until_ready(f(x))
+
+
+def test_sentinel_warm_loop_is_free():
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.ones((16,), jnp.float32)
+    jax.block_until_ready(f(x))  # compile outside the region
+    with CompileSentinel("warm", budget=0) as cs:
+        for _ in range(3):
+            jax.block_until_ready(f(x))
+    assert cs.compiles == 0
+
+
+def test_sentinel_never_masks_an_exception_in_flight():
+    f = jax.jit(lambda x: x * 5)
+    x = jnp.ones((17,), jnp.float32)
+    with pytest.raises(ValueError, match="boom"):
+        with CompileSentinel("mask", budget=0):
+            jax.block_until_ready(f(x))  # blows the budget...
+            raise ValueError("boom")  # ...but the real error wins
+
+
+# -- acceptance: zero recompiles across 3 warm cycles ------------------------
+
+
+def test_xla_twin_three_warm_cycles_zero_recompiles(snapshot):
+    from kube_batch_tpu.ops.kernels import _solve_fresh
+
+    dev = jax.device_put(snapshot)
+    jax.block_until_ready(_solve_fresh(dev, True, True))  # compile + warm
+    with CompileSentinel("xla_twin warm cycles", budget=0) as cs:
+        for _ in range(3):
+            jax.block_until_ready(_solve_fresh(dev, True, True))
+    assert cs.compiles == 0
+
+
+def test_sharded_rung_three_warm_cycles_zero_recompiles(snapshot):
+    from kube_batch_tpu.parallel.sharded import AXIS_NAME, _sharded_programs
+
+    devices = tuple(jax.devices())
+    if len(devices) < 2:
+        pytest.skip("needs >=2 host devices (conftest forces 8)")
+    fresh, _resume = _sharded_programs(
+        devices[:2], AXIS_NAME, frozenset(snapshot), True, True
+    )
+    jax.block_until_ready(fresh(snapshot))  # compile + warm
+    with CompileSentinel("sharded@2 warm cycles", budget=0) as cs:
+        for _ in range(3):
+            jax.block_until_ready(fresh(snapshot))
+    assert cs.compiles == 0
+
+
+# -- live tree ---------------------------------------------------------------
+
+
+def test_snapshot_speaks_the_action_layer_contract(snapshot):
+    # host-only metadata dropped, nodeorder weights folded in, all f32 —
+    # the exact dict actions/xla_allocate hands the solvers
+    assert "task_created" not in snapshot
+    for k in ("w_least", "w_balanced", "w_aff", "w_podaff"):
+        assert snapshot[k].dtype == np.float32
+    # node bucket pads so every mesh size in {1,2,4,8} divides it
+    n_nodes = snapshot["node_idle"].shape[0]
+    assert all(n_nodes % m == 0 for m in trace.MESH_SIZES_DEFAULT)
+
+
+def test_live_tree_trace_audit_clean_under_committed_baseline():
+    findings, info = trace.run_trace_audit()
+    bl = load_baseline(os.path.join(REPO, "hack", "trace-baseline.toml"), REPO)
+    assert bl.errors == [], [e.message for e in bl.errors]
+    kept, _suppressed, _stale = apply_baseline(findings, bl)
+    assert kept == [], "unsuppressed trace findings:\n" + "\n".join(
+        f.render() for f in kept
+    )
+    # every tier was actually traced
+    assert info["entries"]["xla_twin"] > 0
+    assert info["entries"]["pallas_solve"] > 0
+    assert any(e.startswith("mesh_pallas@") for e in info["entries"])
