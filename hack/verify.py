@@ -32,7 +32,11 @@ Runs, in order:
    through a real scheduling path, asserting binds still land;
 7. the encode-cache parity smoke (python -m kube_batch_tpu.ops.encode_cache):
    warm and 1%-node-churn encodes must be byte-identical to a fresh
-   cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on).
+   cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on);
+8. the streaming smoke (python -m kube_batch_tpu.streaming --json):
+   event-driven micro-cycles must bind every arrival AND place it on
+   the same node a pure full-cycle twin picks (parity), with at least
+   one micro-cycle actually taken.
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
 (tests/test_faults.py + tests/test_recovery.py — fault drills, the
@@ -493,6 +497,35 @@ def main(argv: list[str] | None = None) -> int:
     gates["encode_cache_smoke"] = {"ok": res.returncode == 0}
     if res.returncode != 0:
         print("verify: encode-cache parity smoke FAILED")
+        failed = True
+
+    # 7b. streaming smoke: micro-cycles bind every arrival and agree
+    # bind-for-bind with a full-cycle twin (python -m
+    # kube_batch_tpu.streaming). The detector env from the chaos gate
+    # stays on — micro-cycles must hold the no-mutation contract too.
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.streaming", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    stream_summary: dict = {}
+    try:
+        stream_summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    stream_ok = (
+        res.returncode == 0
+        and stream_summary.get("ok", False)
+        and stream_summary.get("parity", False)
+        and stream_summary.get("micro_cycles", 0) > 0
+    )
+    gates["streaming_smoke"] = {
+        "ok": stream_ok,
+        "micro_cycles": stream_summary.get("micro_cycles", 0),
+        "p50_bind_ms": stream_summary.get("p50_bind_ms"),
+    }
+    if not stream_ok:
+        print(res.stdout, res.stderr, sep="\n")
+        print("verify: streaming smoke FAILED")
         failed = True
 
     # 8. --chaos: the full chaos-marked suite + fsck on a seeded journal

@@ -37,14 +37,30 @@ class EnqueueAction(Action):
                     jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 jobs_map[job.queue].push(job)
 
-        # Idle headroom with 1.2x overcommit (enqueue.go:78-82).
+        # Idle headroom with 1.2x overcommit (enqueue.go:78-82) —
+        # computed lazily: it is only consumed by the MinResources
+        # admission branch (jobs whose pods don't exist yet), and the
+        # per-node Resource churn is the action's whole cost on big
+        # clusters (streaming micro-cycles run this action per arrival,
+        # where every gang has pods and the sweep would be dead work).
         empty = Resource.empty()
-        nodes_idle = Resource.empty()
-        for node in ssn.nodes.values():
-            nodes_idle.add(node.allocatable.clone().multi(OVERCOMMIT_FACTOR).sub(node.used))
+        nodes_idle: Resource = None  # type: ignore[assignment]
+
+        def idle() -> Resource:
+            nonlocal nodes_idle
+            if nodes_idle is None:
+                nodes_idle = Resource.empty()
+                for node in ssn.nodes.values():
+                    nodes_idle.add(
+                        node.allocatable.clone().multi(OVERCOMMIT_FACTOR).sub(node.used)
+                    )
+            return nodes_idle
 
         while not queues.empty():
-            if nodes_idle.less(empty):
+            # per-node overcommitted idle is never negative, so the sum
+            # only goes negative after a MinResources subtraction — no
+            # need to force the sweep just for this check
+            if nodes_idle is not None and nodes_idle.less(empty):
                 break
             queue = queues.pop()
             jobs = jobs_map.get(queue.name)
@@ -60,8 +76,8 @@ class EnqueueAction(Action):
                 inqueue = True
             else:
                 pg_resource = Resource.from_resource_list(job.pod_group.spec.min_resources)
-                if pg_resource.less_equal(nodes_idle):
-                    nodes_idle.sub(pg_resource)
+                if pg_resource.less_equal(idle()):
+                    idle().sub(pg_resource)
                     inqueue = True
 
             if inqueue:

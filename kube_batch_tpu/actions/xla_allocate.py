@@ -125,6 +125,11 @@ class XlaAllocateAction(Action):
         # "sharded_xla", "pallas", "xla", "serial"); bench rows assert on
         # this so a silent downgrade cannot masquerade as evidence.
         self.last_solver_tier = "none"
+        # Whether the last FULL-cycle encode saw any pod-affinity terms
+        # (pending or resident). Streaming micro-cycles pass this as the
+        # resident_interpod hint so the encode skips the O(resident-pods)
+        # sweep over every node's task map (see encode_session).
+        self.last_interpod_active = False
 
     @property
     def name(self) -> str:
@@ -213,6 +218,7 @@ class XlaAllocateAction(Action):
         enable_drf = "drf" in order
         enable_proportion = "proportion" in order
 
+        micro = bool(getattr(ssn, "micro_cycle", False))
         t0 = _time.perf_counter()
         enc = encode_session(
             ssn.jobs,
@@ -222,7 +228,10 @@ class XlaAllocateAction(Action):
             drf=ssn.plugins.get("drf") if enable_drf else None,
             proportion=ssn.plugins.get("proportion") if enable_proportion else None,
             session=ssn,
+            resident_interpod=self.last_interpod_active if micro else None,
         )
+        if not micro:
+            self.last_interpod_active = bool(enc.interpod_active)
         if not enc.tasks:
             return
         t_encode = _time.perf_counter() - t0
@@ -910,7 +919,7 @@ class _Replayer:
         # Invalidate state_seq-keyed score memos (nodeorder/tensorscore):
         # the replay mutates node accounting without going through
         # ssn.allocate/pipeline, which are what normally bump the seq.
-        self.ssn.state_seq += 1
+        self.ssn.bump_state()
 
     def apply_upto(self, assign_pos, assigned_node, assigned_kind, step: int) -> None:
         """Apply all events with replayed <= pos < step — the same net
@@ -935,7 +944,7 @@ class _Replayer:
         self.decided_at[rows] = _time.time()  # this segment's solve completion
         # Same memo invalidation as apply_immediate: bulk replay mutates
         # node.used/tasks behind the session's back.
-        self.ssn.state_seq += 1
+        self.ssn.bump_state()
         rows = rows[np.argsort(assign_pos[rows], kind="stable")]
         nrows = assigned_node[rows]
         kinds = assigned_kind[rows]

@@ -1,4 +1,4 @@
-"""A3 — registry-consistency analyzer (KBT-R001..R005).
+"""A3 — registry-consistency analyzer (KBT-R001..R006).
 
 Three registries grew to dozens of names across PR 1-3, each previously
 checked only by grep and luck:
@@ -23,6 +23,10 @@ checked only by grep and luck:
   argument (``_env_int("KBT_...", d)``), and from module-level
   ALL-CAPS constants bound to a ``KBT_*`` string (the
   ``ENV = "KBT_..."`` indirection in mutation_detector).
+- **state_seq bumps**: every session mutation must advance the counter
+  through ``Session.bump_state()`` (R006) — a raw ``state_seq += 1``
+  (or assignment) outside that one hook is a mutation the streaming
+  dirty tracker and state_seq-keyed score memos cannot observe.
 """
 
 from __future__ import annotations
@@ -218,6 +222,54 @@ def _check_metrics(files: list[SourceFile], findings: list[Finding]) -> None:
                 )
 
 
+# -- state_seq bump discipline -----------------------------------------------
+
+SESSION_MODULE = "kube_batch_tpu/framework/session.py"
+_BUMP_OWNERS = ("bump_state", "__init__")
+
+
+def _check_state_seq(files: list[SourceFile], findings: list[Finding]) -> None:
+    """KBT-R006: no raw ``<obj>.state_seq += 1`` / ``= n`` bump sites
+    outside Session.bump_state (and the counter's __init__)."""
+    for sf in files:
+        owners: dict[int, str] = {}  # lineno -> enclosing function name
+        if sf.path == SESSION_MODULE:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if hasattr(sub, "lineno"):
+                            owners.setdefault(sub.lineno, node.name)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                # `x.state_seq = y.state_seq` is a memo of the observed
+                # counter (encode_cache task blocks), not a bump.
+                if (
+                    isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "state_seq"
+                ):
+                    continue
+                targets = node.targets
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute) and t.attr == "state_seq"):
+                    continue
+                if owners.get(node.lineno) in _BUMP_OWNERS:
+                    continue
+                findings.append(
+                    Finding(
+                        sf.path, node.lineno, "KBT-R006",
+                        "raw state_seq bump outside Session.bump_state() — "
+                        "the streaming dirty tracker and state_seq-keyed "
+                        "score memos cannot observe this mutation; call "
+                        "bump_state() instead",
+                        symbol="state_seq",
+                    )
+                )
+
+
 # -- env knobs ---------------------------------------------------------------
 
 
@@ -326,5 +378,6 @@ def analyze(
     findings: list[Finding] = []
     _check_fault_points(files, findings)
     _check_metrics(files, findings)
+    _check_state_seq(files, findings)
     _check_env(files, repo, runbook, findings)
     return findings

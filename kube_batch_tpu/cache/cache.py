@@ -74,12 +74,15 @@ from kube_batch_tpu.utils.workqueue import RateLimitingQueue
 _encode_cache = None
 
 
-def _notify_encode_cache(kind: str, key: str) -> None:
+def _notify_encode_cache(kind: str, key: str, obj=None, old=None) -> None:
     """Dirty-feed hook for the incremental encoder
     (ops/encode_cache.py): every informer event bumps the monotonic
-    store version and drops the churned object's memo entries. Lazily
-    imported — the ops package pulls jax, which cache construction must
-    not require."""
+    store version and drops the churned object's memo entries; the same
+    feed fans out ``(kind, key, obj, old)`` to streaming-mode listeners
+    (streaming.py) so micro-cycles wake on churn instead of polling.
+    Lazily imported — the ops package pulls jax, which cache
+    construction must not require. Called AFTER releasing the mirror
+    mutex (listeners may take their own locks)."""
     global _encode_cache
     if _encode_cache is None:
         try:
@@ -89,7 +92,7 @@ def _notify_encode_cache(kind: str, key: str) -> None:
             return
         _encode_cache = _ec
     if _encode_cache is not False:
-        _encode_cache.note_store_event(kind, key)
+        _encode_cache.note_store_event(kind, key, obj=obj, old=old)
 
 SHADOW_POD_GROUP_KEY = "kube-batch-tpu/shadow-pod-group"
 
@@ -725,7 +728,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to add pod %s/%s to cache: %s", pod.namespace, pod.name, e)
                 return
-        _notify_encode_cache(PODS, pod.metadata.uid)
+        _notify_encode_cache(PODS, pod.metadata.uid, obj=pod)
         log.V(3).infof("Added pod <%s/%s> to cache", pod.namespace, pod.name)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
@@ -736,7 +739,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to update pod %s/%s in cache: %s", new.namespace, new.name, e)
                 return
-        _notify_encode_cache(PODS, new.metadata.uid)
+        _notify_encode_cache(PODS, new.metadata.uid, obj=new, old=old)
         log.V(3).infof("Updated pod <%s/%s> in cache", new.namespace, new.name)
 
     def delete_pod(self, pod: Pod) -> None:
@@ -746,7 +749,7 @@ class SchedulerCache:
             except KeyError as e:
                 log.errorf("Failed to delete pod %s/%s from cache: %s", pod.namespace, pod.name, e)
                 return
-        _notify_encode_cache(PODS, pod.metadata.uid)
+        _notify_encode_cache(PODS, pod.metadata.uid, old=pod)
         log.V(3).infof("Deleted pod <%s/%s> from cache", pod.namespace, pod.name)
 
     # -- node handlers (reference event_handlers.go:262-370) ---------------
@@ -757,7 +760,7 @@ class SchedulerCache:
                 self.nodes[node.name].set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
-        _notify_encode_cache(NODES, node.name)
+        _notify_encode_cache(NODES, node.name, obj=node)
 
     def update_node(self, old: Node, new: Node) -> None:
         with self._mutex:
@@ -778,7 +781,7 @@ class SchedulerCache:
             else:
                 changed = False
         if changed:
-            _notify_encode_cache(NODES, new.name)
+            _notify_encode_cache(NODES, new.name, obj=new, old=old)
 
     def delete_node(self, node: Node) -> None:
         with self._mutex:
@@ -786,7 +789,7 @@ class SchedulerCache:
                 log.errorf("Failed to delete node %s: does not exist in cache", node.name)
                 return
             del self.nodes[node.name]
-        _notify_encode_cache(NODES, node.name)
+        _notify_encode_cache(NODES, node.name, old=node)
 
     # -- podgroup handlers (reference event_handlers.go:372-493) -----------
 
@@ -802,11 +805,17 @@ class SchedulerCache:
     def add_pod_group(self, pg: PodGroup) -> None:
         with self._mutex:
             self._set_pod_group(pg)
+        _notify_encode_cache(
+            POD_GROUPS, f"{pg.metadata.namespace}/{pg.name}", obj=pg
+        )
         log.V(4).infof("Added PodGroup <%s/%s> to cache", pg.metadata.namespace, pg.name)
 
     def update_pod_group(self, old: PodGroup, new: PodGroup) -> None:
         with self._mutex:
             self._set_pod_group(new)
+        _notify_encode_cache(
+            POD_GROUPS, f"{new.metadata.namespace}/{new.name}", obj=new, old=old
+        )
 
     def delete_pod_group(self, pg: PodGroup) -> None:
         with self._mutex:
@@ -817,6 +826,7 @@ class SchedulerCache:
                 return
             job.unset_pod_group()
             self._delete_job(job)
+        _notify_encode_cache(POD_GROUPS, f"{pg.metadata.namespace}/{pg.name}", old=pg)
 
     # -- pdb handlers (reference event_handlers.go:494-604) ----------------
 
@@ -855,15 +865,18 @@ class SchedulerCache:
         with self._mutex:
             qi = QueueInfo(q)
             self.queues[qi.name] = qi
+        _notify_encode_cache(QUEUES, q.name, obj=q)
 
     def update_queue(self, old: Queue, new: Queue) -> None:
         with self._mutex:
             self.queues.pop(old.name, None)
             self.queues[new.name] = QueueInfo(new)
+        _notify_encode_cache(QUEUES, new.name, obj=new, old=old)
 
     def delete_queue(self, q: Queue) -> None:
         with self._mutex:
             self.queues.pop(q.name, None)
+        _notify_encode_cache(QUEUES, q.name, old=q)
 
     # -- priorityclass handlers (reference event_handlers.go:701-795) ------
 
@@ -1175,6 +1188,41 @@ class SchedulerCache:
                 len(snapshot.jobs), len(snapshot.queues), len(snapshot.nodes),
             )
             return snapshot
+
+    def clone_jobs_for_stream(
+        self, job_keys
+    ) -> tuple[dict[str, JobInfo], set[str]]:
+        """Fresh clones of just the named jobs, with exactly snapshot()'s
+        admission filters and priority resolution — the streaming
+        micro-cycle's restricted job view (streaming.py). Returns
+        ``(jobs, missing)``: keys the mirror does not track at all land
+        in ``missing`` (the gang is gone — prune it from the backlog);
+        jobs that merely fail an admission filter are omitted from both
+        (not schedulable this micro-cycle; the full cycle decides)."""
+        with self._mutex:
+            out: dict[str, JobInfo] = {}
+            missing: set[str] = set()
+            for uid in job_keys:
+                job = self.jobs.get(uid)
+                if job is None:
+                    missing.add(uid)
+                    continue
+                if job.pod_group is None and job.pdb is None:
+                    continue
+                if job.queue not in self.queues:
+                    continue
+                if job.pod_group is not None:
+                    job.priority = self._default_priority
+                    pc = self.priority_classes.get(job.pod_group.spec.priority_class_name)
+                    if pc is not None:
+                        job.priority = pc.value
+                out[uid] = job.clone()
+            return out, missing
+
+    def clone_queues_for_stream(self) -> dict[str, QueueInfo]:
+        """All queues, cloned under the mutex (snapshot()'s queue leg)."""
+        with self._mutex:
+            return {name: q.clone() for name, q in self.queues.items()}
 
     # -- status write-back (reference cache.go:621-666) --------------------
 

@@ -98,12 +98,19 @@ class SchedulerConfiguration:
     effect on the next cycle via the hot reload, no restart::
 
         faults: "bind.write:1:2,watch.drop:0.5"
+
+    and ``streaming``: opt-in for event-driven micro-cycles between
+    periodic full cycles (kube_batch_tpu.streaming; the KBT_STREAMING
+    env var is the equivalent process-wide switch)::
+
+        streaming: true
     """
 
     actions: str = ""
     tiers: list[Tier] = field(default_factory=list)
     action_arguments: dict[str, dict[str, str]] = field(default_factory=dict)
     faults: str = ""
+    streaming: bool = False
 
 
 # Default conf (reference util.go:31-42).
@@ -135,6 +142,7 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
     conf = SchedulerConfiguration(
         actions=str(data.get("actions", "")),
         faults=str(data.get("faults") or ""),
+        streaming=bool(data.get("streaming", False)),
     )
     for action_name, args in (data.get("actionArguments") or {}).items():
         conf.action_arguments[str(action_name)] = {

@@ -94,6 +94,8 @@ POINTS = (
     "cycle.overrun",    # injected wedged solve -> hard-deadline abort pre-dispatch
     # incremental encode cache (ops/encode_cache.py)
     "encode.cache",     # cache poisoned -> state dropped, encode runs cold
+    # streaming micro-cycles (scheduler.py run_micro)
+    "stream.micro_cycle",  # micro-cycle solve fails -> degrade to full cycle, no pod dropped
     # native extension boundary (ops/, the bulk replay)
     "native.load",      # extension unavailable for the cycle -> Python twins
     "native.prepass",   # bulk_assign prepass raises -> Python replay

@@ -89,6 +89,16 @@ class Session:
         self.job_pipelined_fns: dict[str, Callable] = {}
         self.job_valid_fns: dict[str, Callable] = {}
 
+    def bump_state(self) -> None:
+        """THE session-state mutation hook: every allocate/pipeline/evict,
+        Statement do/undo op, and the bulk replay advances ``state_seq``
+        through here (never by touching the counter directly — analysis
+        check KBT-R006 enforces it). One site means one place to observe
+        mutation: plugin score memos key off the counter, and the
+        streaming micro-cycle's task-block reuse depends on every
+        mutation path bumping it."""
+        self.state_seq += 1
+
     # -- fn registration (session_plugins.go:25-88) -------------------------
 
     def add_job_order_fn(self, name: str, fn: Callable) -> None:
@@ -347,7 +357,7 @@ class Session:
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Assign onto releasing resources; session-only, no bind
         (session.go:198-238)."""
-        self.state_seq += 1
+        self.bump_state()
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
@@ -364,7 +374,7 @@ class Session:
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         """Allocate idle resources; dispatch the whole gang once JobReady
         (the gang barrier, session.go:241-296)."""
-        self.state_seq += 1
+        self.bump_state()
         self.cache.allocate_volumes(task, hostname)
         job = self.jobs.get(task.job)
         if job is None:
@@ -406,7 +416,7 @@ class Session:
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """session.go:325-362."""
-        self.state_seq += 1
+        self.bump_state()
         self.cache.evict(reclaimee, reason)
         job = self.jobs.get(reclaimee.job)
         if job is None:
@@ -471,18 +481,29 @@ def open_session(
     cache: Cache,
     tiers: list[Tier],
     action_arguments: Optional[dict[str, dict[str, str]]] = None,
+    world: Optional[tuple[dict, dict, dict]] = None,
 ) -> Session:
     """Snapshot + plugin instantiation + JobValid gate
     (framework.go:30-51 + session.go:66-119; gate ordering fixed, see
-    module docstring)."""
+    module docstring).
+
+    ``world`` — an explicit ``(jobs, nodes, queues)`` triple instead of a
+    fresh ``cache.snapshot()``. The streaming micro-cycle passes its
+    restricted dirty-gang job clones plus the resident node table here
+    (kube_batch_tpu.streaming); everything downstream (plugin
+    registration, JobValid gate, actions, close_session) is identical to
+    a full cycle."""
     ssn = Session(cache)
     ssn.tiers = tiers
     ssn.action_arguments = action_arguments or {}
 
-    snapshot = cache.snapshot()
-    ssn.jobs = snapshot.jobs
-    ssn.nodes = snapshot.nodes
-    ssn.queues = snapshot.queues
+    if world is None:
+        snapshot = cache.snapshot()
+        ssn.jobs = snapshot.jobs
+        ssn.nodes = snapshot.nodes
+        ssn.queues = snapshot.queues
+    else:
+        ssn.jobs, ssn.nodes, ssn.queues = world
 
     for tier in tiers:
         for option in tier.plugins:

@@ -28,7 +28,7 @@ class Statement:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """statement.go:37-69: mark Releasing in session, log the op."""
         ssn = self._ssn
-        ssn.state_seq += 1
+        ssn.bump_state()
         job = ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.RELEASING)
@@ -43,7 +43,7 @@ class Statement:
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """statement.go:113-154."""
         ssn = self._ssn
-        ssn.state_seq += 1
+        ssn.bump_state()
         job = ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PIPELINED)
@@ -61,7 +61,7 @@ class Statement:
     def _unevict(self, reclaimee: TaskInfo) -> None:
         """statement.go:83-110: restore the victim to Running."""
         ssn = self._ssn
-        ssn.state_seq += 1
+        ssn.bump_state()
         job = ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.RUNNING)
@@ -75,7 +75,7 @@ class Statement:
     def _unpipeline(self, task: TaskInfo) -> None:
         """statement.go:159-195: back to Pending, off the node."""
         ssn = self._ssn
-        ssn.state_seq += 1
+        ssn.bump_state()
         job = ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PENDING)

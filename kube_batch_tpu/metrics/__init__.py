@@ -282,6 +282,22 @@ encode_warm_fraction = Gauge(
     "(0 = fully cold)",
 )
 
+# -- streaming scheduler (kube_batch_tpu.streaming) --------------------------
+time_to_bind = Histogram(
+    f"{_SUBSYSTEM}_time_to_bind_seconds",
+    "Arrival-event to bind-ack latency per pod in seconds",
+    E2E_BUCKETS,
+)
+micro_cycles = Counter(
+    f"{_SUBSYSTEM}_micro_cycles_total",
+    "Streaming micro-cycles run, by outcome "
+    "(ok/empty/aborted/fault/stale/degraded)",
+)
+streaming_backlog = Gauge(
+    f"{_SUBSYSTEM}_streaming_backlog_pods",
+    "Pods arrived but not yet bound that streaming mode is tracking",
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -390,6 +406,18 @@ def set_encode_warm_fraction(fraction: float) -> None:
     encode_warm_fraction.set(fraction)
 
 
+def observe_time_to_bind(seconds: float) -> None:
+    time_to_bind.observe(seconds)
+
+
+def register_micro_cycle(outcome: str) -> None:
+    micro_cycles.inc({"outcome": outcome})
+
+
+def set_streaming_backlog(n: int) -> None:
+    streaming_backlog.set(n)
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -453,6 +481,9 @@ def render_prometheus_text() -> str:
         encode_cache_hits,
         encode_cache_invalidations,
         encode_warm_fraction,
+        time_to_bind,
+        micro_cycles,
+        streaming_backlog,
     ]
     lines: list[str] = []
     for metric in families:

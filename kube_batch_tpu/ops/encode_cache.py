@@ -335,10 +335,45 @@ def active() -> Optional[EncodeCache]:
     return _cache
 
 
-def note_store_event(kind: str, key: str) -> None:
-    """Module-level dirty-feed entry point (what cache/cache.py calls)."""
+# Streaming-mode listeners (kube_batch_tpu/streaming.py): each gets the
+# full event `(kind, key, obj, old)` regardless of whether the encode
+# cache itself is enabled — the dirty feed doubles as the scheduler's
+# wake-up trigger. Listener errors are swallowed per call: an informer
+# thread must never die on a trigger bug (the periodic full cycle is
+# the backstop either way).
+_listeners: list = []
+_listeners_lock = threading.Lock()
+
+
+def add_store_listener(fn) -> None:
+    with _listeners_lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_store_listener(fn) -> None:
+    with _listeners_lock:
+        if fn in _listeners:
+            _listeners.remove(fn)
+
+
+def note_store_event(kind: str, key: str, obj=None, old=None) -> None:
+    """Module-level dirty-feed entry point (what cache/cache.py calls).
+    ``obj`` is the post-event object (None on delete), ``old`` the
+    pre-event one (None on add) — the streaming trigger patches its
+    resident state from these without re-reading the store."""
     if enabled():
         _cache.note_store_event(kind, key)
+    if _listeners:
+        with _listeners_lock:
+            listeners = list(_listeners)
+        for fn in listeners:
+            try:
+                fn(kind, key, obj, old)
+            except Exception as e:  # noqa: BLE001 - see registry comment
+                from kube_batch_tpu import log
+
+                log.errorf("store listener failed on %s/%s: %s", kind, key, e)
 
 
 # -- device-resident tensor arena -------------------------------------------

@@ -29,6 +29,7 @@ from typing import Optional
 import kube_batch_tpu.actions  # noqa: F401  (registers the action pipeline)
 import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
 from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.conf import (
     load_scheduler_conf,
     parse_scheduler_conf,
@@ -101,6 +102,15 @@ class Scheduler:
         # success every cycle, which would reset per-call failures and
         # make the downgrade unreachable.
         self._soft_overruns = 0
+        # Streaming mode (streaming.py): event-driven micro-cycles
+        # between periodic full cycles. Armed by the conf `streaming:`
+        # key or KBT_STREAMING; _stream_state is non-None only while
+        # _run_streaming is live, and run_once harvests its resident
+        # node table through it.
+        self._conf_streaming = False
+        self._stream_state = None
+        self._stream_trigger = None
+        self.micro_cycles_run = 0
         self._load_conf()
 
     def _load_conf(self) -> None:
@@ -125,12 +135,13 @@ class Scheduler:
                 conf_str
             )
             self._conf_cache = conf_str
+            parsed = parse_scheduler_conf(conf_str)
+            self._conf_streaming = parsed.streaming
             # Conf-driven fault drills (the `faults:` key, same grammar as
             # KBT_FAULTS): armed only when the conf actually changed, so a
             # drill's fire counts are not re-armed every cycle.
-            spec = parse_scheduler_conf(conf_str).faults
-            if spec:
-                faults.registry.configure(spec)
+            if parsed.faults:
+                faults.registry.configure(parsed.faults)
         except Exception as e:  # noqa: BLE001 - bad conf must not kill the loop
             if self._conf_cache is None:
                 raise
@@ -138,10 +149,16 @@ class Scheduler:
 
     def run(self, stop: threading.Event) -> None:
         """Start the cache and loop run_once until stopped
-        (reference scheduler.go:63-86)."""
+        (reference scheduler.go:63-86). When streaming mode is armed
+        (conf `streaming:` key or KBT_STREAMING), the fixed-period sleep
+        is replaced by the event-driven micro-cycle loop; flipping the
+        conf key off returns here on the next iteration."""
         self.cache.run()
         self.cache.wait_for_cache_sync()
         while not stop.is_set():
+            if self._streaming_on():
+                self._run_streaming(stop)
+                continue
             start = time.perf_counter()
             try:
                 self.run_once()
@@ -149,6 +166,177 @@ class Scheduler:
                 log.errorf("scheduling cycle failed: %s", e)
             elapsed = time.perf_counter() - start
             stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def _streaming_on(self) -> bool:
+        from kube_batch_tpu import streaming
+
+        return streaming.enabled() or self._conf_streaming
+
+    def _run_streaming(self, stop: threading.Event) -> None:
+        """The streaming loop (streaming.py): full cycles keep running
+        every schedule_period as the fairness/preemption backstop; in
+        between, the trigger wakes on store churn and micro-cycles
+        drain the dirty-gang backlog against the resident node table.
+        Any micro-cycle that cannot complete degrades to an immediate
+        full cycle — arrivals are never dropped, only served slower."""
+        from kube_batch_tpu import streaming
+
+        trigger = streaming.StreamTrigger()
+        state = streaming.StreamState()
+        self._stream_trigger = trigger
+        self._stream_state = state
+        trigger.attach()
+        log.infof(
+            "streaming mode on: micro-cycles between full cycles every %.2fs",
+            self.schedule_period,
+        )
+        try:
+            next_full = time.monotonic()  # first full cycle immediately
+            while not stop.is_set() and self._streaming_on():
+                now = time.monotonic()
+                if now >= next_full:
+                    try:
+                        self.run_once()  # harvests the resident table
+                    except Exception as e:  # noqa: BLE001
+                        log.errorf("scheduling cycle failed: %s", e)
+                        state.invalidate("full cycle failed")
+                    next_full = time.monotonic() + self.schedule_period
+                    continue
+                if not trigger.wait(min(next_full - now, 0.5)):
+                    continue
+                work = trigger.drain()
+                handled = False
+                try:
+                    handled = self.run_micro(work)
+                except Exception as e:  # noqa: BLE001
+                    log.errorf(
+                        "micro-cycle failed: %s; degrading to a full cycle", e
+                    )
+                    state.invalidate("micro-cycle failed")
+                    metrics.register_micro_cycle("degraded")
+                if not handled:
+                    next_full = time.monotonic()  # backstop now, not in period
+        finally:
+            trigger.detach()
+            self._stream_trigger = None
+            self._stream_state = None
+            log.infof("streaming mode off: back to the fixed-period loop")
+
+    def run_micro(self, work) -> bool:
+        """One micro-cycle over the drained churn. Returns True when the
+        backlog was served (or there was nothing to solve); False means
+        the caller must run a full cycle now — the resident table was
+        stale/invalid, a fault fired, or the cycle aborted on deadline.
+        Either way no arrival is lost: the trigger keeps every gang
+        until ``prune`` sees it bound or gone."""
+        from kube_batch_tpu import streaming  # noqa: F401  (docs pair this file)
+
+        st = self._stream_state
+        trigger = self._stream_trigger
+        if st is None or trigger is None:
+            return False
+        if not st.valid:
+            metrics.register_micro_cycle("stale")
+            log.V(4).infof("micro-cycle skipped: resident table invalid (%s)", st.reason)
+            return False
+        if work.stale:
+            st.invalidate(work.stale_reason)
+            metrics.register_micro_cycle("stale")
+            log.infof(
+                "resident table stale (%s); degrading to a full cycle",
+                work.stale_reason,
+            )
+            return False
+        if faults.should_fire("stream.micro_cycle"):
+            # injected micro-solve failure: invalidate and degrade to the
+            # backstop full cycle — the backlog is untouched, no pod drops
+            st.invalidate("stream.micro_cycle fault")
+            metrics.register_micro_cycle("fault")
+            return False
+        # no _load_conf() here: conf reload (a file read + parse) stays a
+        # full-cycle affair — the backstop cycle picks up pushes within
+        # one schedule_period, and the micro hot path stays disk-free
+        detector = None
+        if mutation_detector.enabled():
+            store = getattr(self.cache, "store", None)
+            if store is not None:
+                detector = mutation_detector.MutationDetector(store)
+                detector.snapshot()
+        if hasattr(self.cache, "cycle"):
+            self.cache.cycle += 1
+        st.apply_node_patches(work.node_patches)
+        cloned, missing = self.cache.clone_jobs_for_stream(work.gangs)
+        # A gang is solvable only once enough of it exists: the podgroup
+        # add event lands before its member pods, and a mid-burst drain
+        # sees a partial gang — opening a session for either wastes a
+        # full micro-cycle (the gang gate would discard it anyway). A
+        # deferred gang stays in the backlog; its remaining pod arrivals
+        # re-wake the trigger, and the backstop full cycle catches any
+        # gang that never completes.
+        jobs = {}
+        settled = set(missing)
+        for uid, job in cloned.items():
+            pending = job.task_status_index.get(TaskStatus.PENDING)
+            if not pending:
+                settled.add(uid)  # fully placed (or empty): nothing to solve
+            elif len(job.tasks) >= job.min_available:
+                jobs[uid] = job
+        if settled:
+            trigger.prune(settled)
+        if not jobs:
+            metrics.register_micro_cycle("empty")
+            return True
+        from kube_batch_tpu.streaming import open_micro_session
+
+        budget = CycleBudget(self._soft_deadline, self._hard_deadline)
+        ssn = open_micro_session(
+            self.cache, self.plugins, self.action_arguments,
+            jobs, st.nodes, self.cache.clone_queues_for_stream(),
+        )
+        ssn.cycle_budget = budget
+        ssn.micro_cycle = True  # xla_allocate reads this for the
+        # resident-interpod hint; tests read it to prove the micro path ran
+        aborted: Optional[CycleDeadlineExceeded] = None
+        failed = True
+        try:
+            for action in self.actions:
+                try:
+                    action_start = time.perf_counter()
+                    action.execute(ssn)
+                    metrics.update_action_duration(
+                        action.name, time.perf_counter() - action_start
+                    )
+                    budget.check(f"after action {action.name}")
+                except CycleDeadlineExceeded as e:
+                    aborted = e
+                    break
+            failed = False
+        finally:
+            if failed or aborted is not None:
+                # the session may have mutated the resident table before
+                # dying — rebuild it from the next full snapshot
+                st.invalidate("micro-cycle aborted" if aborted else "micro-cycle failed")
+            else:
+                done = {
+                    uid
+                    for uid, job in ssn.jobs.items()
+                    if not job.task_status_index.get(TaskStatus.PENDING)
+                }
+                trigger.prune(done)
+            close_session(ssn, discard=failed or aborted is not None)
+            self.micro_cycles_run += 1
+        if aborted is not None:
+            metrics.register_micro_cycle("aborted")
+            metrics.register_cycle_overrun("hard")
+            log.errorf(
+                "micro-cycle aborted: %s (session discarded; degrading to a "
+                "full cycle)", aborted,
+            )
+            return False
+        if detector is not None:
+            detector.verify()  # raises CacheMutationError on violation
+        metrics.register_micro_cycle("ok")
+        return True
 
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-102)."""
@@ -210,6 +398,11 @@ class Scheduler:
                     aborted = e
                     break
         finally:
+            # streaming harvest: grab the session's node table BEFORE
+            # close_session rebinds it — micro-cycles solve against this
+            # resident state until the next full cycle replaces it
+            if self._stream_state is not None:
+                self._stream_state.adopt_full_cycle(ssn, aborted=aborted is not None)
             # discard on abort: skip the status write-back so the
             # store stays byte-identical to the cycle's start (every
             # abort point is pre-dispatch)
