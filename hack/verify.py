@@ -20,7 +20,12 @@ Runs, in order:
    trace-level program auditor (python -m kube_batch_tpu.analysis.trace,
    KBT-P*: jaxpr callbacks, f64 leaks, captured constants, donation,
    cross-tier signature drift) under JAX_PLATFORMS=cpu against
-   hack/trace-baseline.toml;
+   hack/trace-baseline.toml; with ``--interleave``, also the
+   interleaving model checker (python -m
+   kube_batch_tpu.analysis.interleave, KBT-I*: every distinguishable
+   thread schedule of the fixed streaming/takeover scenarios,
+   counterexamples replayable by trace id) against
+   hack/interleave-baseline.toml;
 5. ruff + mypy when importable (CI images that carry them get the full
    gate; their absence degrades to the stdlib checks, loudly — unless
    ``--strict``, which makes a missing tool a FAILURE, so an image
@@ -45,7 +50,7 @@ against a seeded journal fixture (a known half-confirmed WAL must fsck
 clean with the expected orphan count, and ``--strict`` must gate on it).
 
 Exit 0 iff every gate is clean.
-Usage:  python hack/verify.py [--strict] [--chaos] [--json]
+Usage:  python hack/verify.py [--strict] [--chaos] [--interleave] [--json]
 
 ``--json`` appends one machine-readable summary line to stdout
 (per-gate pass/fail + finding counts) so bench/CI can record the
@@ -375,6 +380,46 @@ def run_trace_gate(strict: bool) -> dict:
     }
 
 
+def run_interleave_gate(strict: bool) -> dict:
+    """The interleaving model checker (python -m
+    kube_batch_tpu.analysis.interleave) under JAX_PLATFORMS=cpu: the
+    four fixed streaming/takeover scenarios through every
+    distinguishable schedule. Opt-in via --interleave (it runs real
+    micro/full cycles per schedule, ~tens of solves); the Dockerfile
+    build runs it --strict so the shipped image's scenarios are proven
+    clean. Counterexamples print with their replay command."""
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis.interleave", "--json"]
+        + (["--strict"] if strict else []),
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    summary: dict = {}
+    try:
+        summary = json.loads(res.stdout)
+    except ValueError:
+        print("verify: interleave explorer produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and bool(summary)
+    if not ok:
+        for f in summary.get("findings", []):
+            print(f)
+        print("verify: interleave exploration FAILED (replay the trace id "
+              "with python -m kube_batch_tpu.analysis.interleave --replay)")
+    return {
+        "ok": ok,
+        "schedules": sum(
+            s.get("schedules", 0) for s in summary.get("scenarios", [])
+        ),
+        "counterexamples": sum(
+            len(s.get("counterexamples", [])) for s in summary.get("scenarios", [])
+        ),
+        "suppressed": summary.get("suppressed", 0),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     import json
 
@@ -382,7 +427,11 @@ def main(argv: list[str] | None = None) -> int:
     strict = "--strict" in argv
     chaos = "--chaos" in argv
     as_json = "--json" in argv
-    unknown = [a for a in argv if a not in ("--strict", "--chaos", "--json")]
+    interleave = "--interleave" in argv
+    unknown = [
+        a for a in argv
+        if a not in ("--strict", "--chaos", "--json", "--interleave")
+    ]
     if unknown:
         print(f"verify: unknown argument(s): {' '.join(unknown)}")
         return 2
@@ -438,6 +487,14 @@ def main(argv: list[str] | None = None) -> int:
     gates["trace_audit"] = run_trace_gate(strict)
     if not gates["trace_audit"]["ok"]:
         failed = True
+
+    # 4c. (--interleave) the interleaving model checker (KBT-I0xx):
+    # every distinguishable schedule of the fixed streaming/takeover
+    # scenarios, with counterexamples replayable by trace id
+    if interleave:
+        gates["interleave"] = run_interleave_gate(strict)
+        if not gates["interleave"]["ok"]:
+            failed = True
 
     # 5. the full generic gate, when available (mypy beyond api/ per
     # VERDICT item 7: framework, conf and recovery carry the concurrency

@@ -164,10 +164,15 @@ def run_preempt(
                     assigned = True
 
                 if ssn.job_pipelined(preemptor_job):
-                    stmt.commit()
                     break
 
-            if not ssn.job_pipelined(preemptor_job):
+            # Settle the statement on every way out of the task loop:
+            # the empty-queue break could previously leak it open when
+            # the job was already pipelined (its evictions then never
+            # replayed to the cache).
+            if ssn.job_pipelined(preemptor_job):
+                stmt.commit()
+            else:
                 stmt.discard()
                 continue
 

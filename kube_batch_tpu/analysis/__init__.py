@@ -33,6 +33,12 @@ stdlib-only (ast-based) so the bare container runs the full gate:
   lock-acquisition graph over the threaded layers (built on A1's
   guarded-by seed map) — ABBA cycles and blocking calls (fsync, sleep,
   subprocess, device sync) inside lock-held regions.
+- **A6 protocol lifecycles** (:mod:`.protocol`, KBT-C0xx): the five
+  declared lifecycle state machines (Session open->close, Statement
+  operate->commit|discard, journal append->dispatch->confirm, circuit-
+  breaker tier transitions, StreamState harvest->patch->invalidate->
+  re-harvest) checked path-structurally per function, plus listener
+  register/remove pairing on teardown paths.
 
 A jax-dependent sibling, the **trace-time auditor**
 (:mod:`kube_batch_tpu.analysis.trace`, KBT-P0xx, its own CLI
@@ -40,8 +46,16 @@ A jax-dependent sibling, the **trace-time auditor**
 entry points on abstract inputs and audits the resulting jaxprs /
 lowered programs: host callbacks and warm-cycle transfers, f64 upcast
 leaks, large captured constants, un-honored donation, and cross-tier
-program-signature drift. It shares this package's Finding/CODES/
-baseline machinery; this module stays stdlib-only.
+program-signature drift. A second sibling, the **interleaving model
+checker** (:mod:`kube_batch_tpu.analysis.interleave`, KBT-I0xx, CLI
+``python -m kube_batch_tpu.analysis.interleave``), drives fixed
+streaming/takeover scenarios through every distinguishable thread
+schedule (DPOR-lite over declared step footprints, checked against a
+:class:`~kube_batch_tpu.utils.locking.LockOrderWitness`) and asserts
+bind-for-bind parity, zero lost/duplicate binds, and journal
+consistency per schedule; counterexamples replay by trace id. Both
+share this package's Finding/CODES/baseline machinery; this module
+stays stdlib-only.
 
 Findings print as ``file:line: CODE message``. Intentional deviations
 live in a committed suppression file (``hack/lint-baseline.toml``);
@@ -289,6 +303,86 @@ CODES: dict[str, tuple[str, str]] = {
         "selection numerics diverge structurally between tiers. Fix: "
         "restore the drifted field's shape/dtype in the offending tier.",
     ),
+    "KBT-C001": (
+        "session/Statement left open on an exit path",
+        "A session (open_session/open_micro_session) or Statement "
+        "(statement_factory/ssn.statement()/Statement(ssn)) created in "
+        "this function can reach a function exit, a loop-iteration end, "
+        "or a rebinding without close_session() / commit() / discard() "
+        "on that path. An open statement's operations neither replay to "
+        "the cache nor roll back — the gang-atomicity hole the "
+        "Statement exists to close; a dropped session loses the cycle's "
+        "status write-back. The check is path-structural: a branch your "
+        "invariants make impossible still needs the close, because the "
+        "next refactor makes it possible. Escaping the resource "
+        "(return/alias/store on an object) transfers ownership and "
+        "ends the check; passing it as a call argument does not.",
+    ),
+    "KBT-C002": (
+        "protocol operation outside its owning scope",
+        "Either a raw cache dispatch (cache.bind/bind_many/evict) "
+        "outside the Statement/session layer (framework/session.py, "
+        "framework/statement.py, cache/cache.py) — the write skips the "
+        "operation log and the share event handlers — or a circuit-"
+        "breaker _transition() outside faults/ladder.py / outside the "
+        "declared closed/open/half_open alphabet. Route the bind "
+        "through ssn/Statement (or baseline with parity evidence for a "
+        "vetted bulk-replay), and keep tier transitions inside the "
+        "ladder where the lock/backoff discipline lives.",
+    ),
+    "KBT-C003": (
+        "journal append/dispatch/confirm pairing broken",
+        "A write-intent append (append_intents/_journal_intents) can "
+        "exit its function on a path with no dispatch (_submit_write/"
+        "_do_*) or confirm — an orphan intent every takeover will "
+        "re-litigate — or a module appends but never confirms/"
+        "dispatches (or confirms what it never appends, outside "
+        "recovery/ where takeover confirms a dead leader's intents). "
+        "Dispatch or confirm on every path, or return the seqs to the "
+        "caller who does.",
+    ),
+    "KBT-C004": (
+        "resident-table read after invalidate without re-harvest",
+        "On the same path, a StreamState-like object is invalidate()d "
+        "and then its resident node table is read (.nodes / "
+        "apply_node_patches) with no adopt_full_cycle re-harvest in "
+        "between — a micro-cycle solving against capacity that no "
+        "longer exists. Degrade to the full cycle first (it re-adopts "
+        "the table), or reorder the read before the invalidation.",
+    ),
+    "KBT-C005": (
+        "listener registered without a remove on the teardown path",
+        "add_store_listener()/attach() has no matching remove reachable "
+        "from the registration: neither a finally whose try starts at "
+        "or immediately after the registration, nor a paired teardown "
+        "method (detach/stop/close/...) on the class. The leaked "
+        "listener keeps firing into a stopped loop — every store event "
+        "pays for a consumer that no longer exists, and a re-started "
+        "loop double-registers. Even one statement between the "
+        "registration and the protecting try is one exception away "
+        "from the leak.",
+    ),
+    "KBT-I001": (
+        "interleaving counterexample",
+        "The interleaving model checker "
+        "(kube_batch_tpu.analysis.interleave) found a thread schedule "
+        "under which a scenario invariant breaks: an arrival lost or "
+        "never bound, a bind landing twice, the journal left with "
+        "orphan intents, a lock-order reversal, or placements diverging "
+        "from what every other schedule of the same scenario produced. "
+        "The finding names the trace id — replay it step by step with "
+        "`python -m kube_batch_tpu.analysis.interleave --replay "
+        "<scenario>:<digits>`, fix the race, and re-explore.",
+    ),
+    "KBT-I002": (
+        "interleaving model error",
+        "The scenario model itself is unsound, not the code under test: "
+        "a step acquired a lock outside its declared footprint (so the "
+        "partial-order pruning could have skipped a distinguishable "
+        "schedule), or a scenario build precondition failed. Fix the "
+        "step's declared footprint or the scenario builder before "
+        "trusting any clean result from that scenario.",
+    ),
     "KBT-B001": (
         "baseline entry missing a reason",
         "Every hack/lint-baseline.toml entry must say WHY the finding is "
@@ -507,6 +601,7 @@ def run_suite(
         jax_hazards,
         lock_discipline,
         lock_order,
+        protocol,
         registry_consistency,
         snapshot_escape,
     )
@@ -518,6 +613,7 @@ def run_suite(
     analyzers: list[Callable[..., list[Finding]]] = [
         lock_discipline.analyze,
         lock_order.analyze,
+        protocol.analyze,
         jax_hazards.analyze,
         snapshot_escape.analyze,
     ]

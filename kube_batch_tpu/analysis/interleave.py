@@ -1,0 +1,923 @@
+"""Deterministic interleaving model checker for the streaming/store
+concurrency layer (KBT-I0xx, its own CLI:
+``python -m kube_batch_tpu.analysis.interleave``).
+
+The static suite proves lifecycle and lock invariants *per path*; this
+module proves them *per schedule*. The production concurrency units —
+store event fan-out, micro-cycle drains, full cycles, takeover
+reconciliation, late in-flight dispatches — are modeled as **logical
+threads**: lists of named atomic steps executed from one real driver
+thread against the real objects (``ClusterStore``, ``SchedulerCache``
+without its writer pool so every dispatch is inline, ``StreamTrigger``
+/ ``StreamState`` wired exactly as the crash-consistency e2e wires
+them). The explorer then drives each scenario through **every
+distinguishable interleaving** of those steps and checks, per
+schedule:
+
+- the scenario's invariants (all arrivals bound, no arrival lost from
+  the backlog, journal left with zero orphans, placements equal to the
+  uninterrupted twin, ...);
+- zero lost and zero duplicate binds, counted as store-level
+  ``"" -> node`` transitions by an event handler — the same detector
+  tests/test_streaming.py pins the crash e2e with;
+- bind-for-bind parity across schedules: every clean schedule of a
+  parity scenario must produce the identical placement map;
+- no lock-order reversal, via a :class:`LockOrderWitness` wrapped
+  around the real locks (store/cache/trigger/journal);
+- footprint honesty: each step declares the shared state it may touch,
+  and the witness's ``on_acquire`` hook records what it *actually*
+  locked — an undeclared acquisition is itself a finding, because the
+  pruning below would then be unsound.
+
+**DPOR-lite**: two adjacent steps from different threads with disjoint
+declared footprints commute, so their two orders are the same trace.
+The explorer enumerates only the canonical representative of each
+commutation class (the lexicographic normal form: no adjacent pair may
+have ``tid(a) > tid(b)`` with independent footprints) — classic
+partial-order reduction, sized down for fixed finite scenarios.
+
+**Determinism / replay**: scenarios use a :class:`VirtualClock`
+(injected into the degradation-ladder breakers, advanced once per
+step), fresh worlds per schedule, and no randomness — a schedule is
+fully identified by its trace id ``<scenario>:<tid digits>``. A
+counterexample's trace id is its replay seed:
+``python -m kube_batch_tpu.analysis.interleave --replay broken_drain:011``
+re-runs exactly that schedule step by step, verbosely.
+
+The four default scenarios (ISSUE 9): ``micro_vs_full``,
+``event_vs_invalidate``, ``takeover_vs_dispatch``,
+``watch410_vs_drain``. The intentionally broken fixture
+``broken_drain`` (a trigger whose ``drain()`` empties the backlog
+instead of copy-until-prune) is excluded from the default set; it
+exists so the seeded-counterexample loop stays demonstrably alive —
+``tests/test_interleave.py`` replays its counterexample by trace id.
+
+Baseline: ``hack/interleave-baseline.toml`` (same grammar/loader as
+the lint baseline; absent file = empty baseline). Zero live entries
+today — the four scenarios explore clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kube_batch_tpu.analysis import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    repo_root,
+)
+
+__all__ = [
+    "VirtualClock",
+    "Step",
+    "Scenario",
+    "ScheduleResult",
+    "ScenarioReport",
+    "SCENARIOS",
+    "FIXTURES",
+    "explore",
+    "main",
+]
+
+_SELF_PATH = "kube_batch_tpu/analysis/interleave.py"
+BASELINE = os.path.join("hack", "interleave-baseline.toml")
+
+
+class VirtualClock:
+    """Deterministic monotonic clock: schedule position, not wall time.
+    Injected into the degradation ladder's breakers for the duration of
+    a drive so any backoff/half-open decision depends on the schedule
+    alone, and advanced one tick per executed step."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._t = start
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float = 1.0) -> float:
+        self._t += dt
+        return self._t
+
+
+@dataclass(frozen=True)
+class Step:
+    """One atomic unit of a logical thread. ``footprint`` declares the
+    shared state the step may touch — lock names as wrapped by the
+    scenario witness, plus virtual tokens (``stream_state``) for shared
+    objects that have no lock. Disjoint footprints ⇒ the steps commute
+    (checked at runtime against the locks actually acquired)."""
+
+    name: str
+    fn: Callable[[], None]
+    footprint: frozenset
+
+
+@dataclass
+class ScheduleResult:
+    trace: str  # "<scenario>:<tid digits>"
+    steps: list  # [(virtual time, tid, step name)]
+    violations: list  # [str]
+    fingerprint: object = None  # placement map for parity comparison
+
+
+@dataclass
+class ScenarioReport:
+    name: str
+    describe: str
+    schedules: int = 0
+    pruned_branches: int = 0
+    results: list = field(default_factory=list)  # [ScheduleResult]
+
+    @property
+    def counterexamples(self) -> list:
+        return [r for r in self.results if r.violations]
+
+    def findings(self) -> list:
+        out = []
+        for r in self.counterexamples:
+            for v in r.violations:
+                code = "KBT-I002" if "footprint" in v or "model error" in v else "KBT-I001"
+                out.append(
+                    Finding(
+                        _SELF_PATH, 1, code,
+                        f"[{r.trace}] {v} (replay: python -m "
+                        f"kube_batch_tpu.analysis.interleave --replay {r.trace})",
+                        symbol=r.trace,
+                    )
+                )
+        return out
+
+
+# -- schedule enumeration (lexicographic normal forms) ------------------------
+
+
+def _schedules(plan: list) -> tuple[list, int]:
+    """All canonical interleavings of ``plan`` (a list of per-thread
+    Step lists). A sequence is canonical iff no adjacent pair has
+    ``tid(a) > tid(b)`` with disjoint footprints — exactly one
+    representative per commutation class survives. Returns
+    (orders, pruned branch count)."""
+    counts = [len(t) for t in plan]
+    total = sum(counts)
+    out: list = []
+    pruned = 0
+
+    def rec(prefix: list, pos: list, last) -> None:
+        nonlocal pruned
+        if len(prefix) == total:
+            out.append(tuple(prefix))
+            return
+        for tid in range(len(plan)):
+            if pos[tid] >= counts[tid]:
+                continue
+            step = plan[tid][pos[tid]]
+            if last is not None:
+                ltid, lstep = last
+                if ltid > tid and not (lstep.footprint & step.footprint):
+                    pruned += 1  # swap-equivalent canonical form exists
+                    continue
+            prefix.append(tid)
+            pos[tid] += 1
+            rec(prefix, pos, (tid, step))
+            prefix.pop()
+            pos[tid] -= 1
+
+    rec([], [0] * len(plan), None)
+    return out, pruned
+
+
+# -- scenario scaffolding -----------------------------------------------------
+
+# Serial pipeline without drf/proportion, the conf the streaming parity
+# suite states its bind-for-bind invariant over (tests/test_streaming.py).
+_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: predicates
+  - name: nodeorder
+streaming: true
+"""
+
+# Footprint tokens. Lock names match the witness wrapping in
+# Scenario._wire; STATE is the virtual token for the (lockless,
+# loop-thread-confined) StreamState resident table.
+L_STORE = "store._lock"
+L_CACHE = "cache._mutex"
+L_TRIG = "trigger._lock"
+L_JOURNAL = "journal._lock"
+STATE = "stream_state"
+F_ALL = frozenset({L_STORE, L_CACHE, L_TRIG, L_JOURNAL, STATE})
+F_EVENT = frozenset({L_STORE, L_CACHE, L_TRIG})
+F_STATE = frozenset({STATE})
+F_TRIG = frozenset({L_TRIG})
+
+
+class Scenario:
+    """One fixed concurrency drama. ``build()`` constructs a fresh
+    world and sets ``self.threads``; the explorer executes one schedule
+    and then calls ``invariants()`` / ``fingerprint()``."""
+
+    name = ""
+    describe = ""
+    parity = True  # clean schedules must agree on fingerprint()
+
+    def __init__(self, workdir: str) -> None:
+        self.workdir = workdir
+        self.clock = VirtualClock()
+        self.threads: list = []
+        self._orig_breaker_clocks: dict = {}
+        self.journal = None
+        self.standby_journal = None
+
+    # -- world building (mirrors tests/test_streaming.py's harness) ----------
+
+    def _wire(self, nodes: int = 4, die_after: Optional[int] = None):
+        from kube_batch_tpu import faults
+        from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+        from kube_batch_tpu.cache.store import PODS, EventHandler
+        from kube_batch_tpu.recovery import WriteIntentJournal
+        from kube_batch_tpu.scheduler import Scheduler
+        from kube_batch_tpu.streaming import StreamState, StreamTrigger
+        from kube_batch_tpu.utils.locking import LockOrderWitness
+
+        conf = os.path.join(self.workdir, "conf.yaml")
+        with open(conf, "w", encoding="utf-8") as fh:
+            fh.write(_CONF)
+        self.store = ClusterStore()
+        self._seed(self.store, nodes)
+        self.bind_counts: dict = {}
+
+        def on_update(old, new):
+            if not old.node_name and new.node_name:
+                key = f"{new.namespace}/{new.name}"
+                self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+
+        self.store.add_event_handler(PODS, EventHandler(on_update=on_update))
+        self.journal = WriteIntentJournal(os.path.join(self.workdir, "leader.wal"))
+        binder = None
+        if die_after is not None:
+            binder = _DyingBinder(self.store, die_after)
+        self.cache = SchedulerCache(self.store, journal=self.journal, binder=binder)
+        # no cache.run(): the writer pool stays off, every dispatch is
+        # inline — the step IS the dispatch, which is what makes the
+        # schedule the only source of nondeterminism
+        self.sched = Scheduler(
+            self.cache, scheduler_conf=conf, schedule_period=1000.0
+        )
+        self.trigger = self._make_trigger()
+        self.state = StreamState()
+        self.sched._stream_trigger = self.trigger
+        self.sched._stream_state = self.state
+        self.trigger.attach()
+
+        self.witness = LockOrderWitness()
+        self.store._lock = self.witness.wrap(L_STORE, self.store._lock)
+        self.cache._mutex = self.witness.wrap(L_CACHE, self.cache._mutex)
+        self.trigger._lock = self.witness.wrap(L_TRIG, self.trigger._lock)
+        self.journal._lock = self.witness.wrap(L_JOURNAL, self.journal._lock)
+        for b in faults.solver_ladder.breakers.values():
+            self._orig_breaker_clocks[b] = b._clock
+            b._clock = self.clock.now
+
+    @staticmethod
+    def _make_trigger():
+        from kube_batch_tpu.streaming import StreamTrigger
+
+        return StreamTrigger()
+
+    @staticmethod
+    def _seed(store, nodes: int) -> None:
+        from kube_batch_tpu.testing import build_node, build_queue, build_resource_list
+
+        store.create_queue(build_queue("default"))
+        for i in range(nodes):
+            store.create_node(
+                build_node(
+                    f"n{i}", build_resource_list(cpu=16, memory="16Gi", pods=64)
+                )
+            )
+
+    @staticmethod
+    def _arrive(store, name: str, members: int) -> None:
+        from kube_batch_tpu.testing import build_pod, build_pod_group, build_resource_list
+
+        store.create_pod_group(build_pod_group(name, min_member=members))
+        for m in range(members):
+            store.create_pod(
+                build_pod(
+                    name=f"{name}-p{m}", group_name=name,
+                    req=build_resource_list(cpu=1, memory="512Mi"),
+                )
+            )
+
+    # -- step factories -------------------------------------------------------
+
+    def s_full(self, label: str = "full_cycle") -> Step:
+        return Step(label, self.sched.run_once, F_ALL)
+
+    def s_micro(self, label: str = "micro_drain") -> Step:
+        def fn():
+            self.sched.run_micro(self.trigger.drain())
+
+        return Step(label, fn, F_ALL)
+
+    def s_arrive(self, gang: str, members: int) -> Step:
+        return Step(
+            f"arrive_{gang}",
+            lambda: self._arrive(self.store, gang, members),
+            F_EVENT,
+        )
+
+    # -- harness surface ------------------------------------------------------
+
+    def build(self) -> None:
+        raise NotImplementedError
+
+    def placements(self) -> dict:
+        from kube_batch_tpu.cache.store import PODS
+
+        return {
+            f"{p.namespace}/{p.name}": p.node_name for p in self.store.list(PODS)
+        }
+
+    def fingerprint(self):
+        return self.placements()
+
+    def invariants(self) -> list:
+        out = []
+        placed = self.placements()
+        unbound = sorted(k for k, v in placed.items() if not v)
+        if unbound:
+            out.append(f"arrivals never bound: {unbound}")
+        dupes = {k: n for k, n in self.bind_counts.items() if n != 1}
+        if dupes:
+            out.append(f"non-exactly-once bind transitions: {dupes}")
+        out.extend(self._journal_invariant())
+        return out
+
+    def _journal_invariant(self) -> list:
+        from kube_batch_tpu.recovery import WriteIntentJournal
+
+        if self.journal is None:
+            return []
+        orphans = WriteIntentJournal.replay(self.journal.path).orphans
+        if orphans:
+            return [
+                "journal left with unconfirmed intents: "
+                + ", ".join(f"{i.op} {i.pod} seq={i.seq}" for i in orphans)
+            ]
+        return []
+
+    def cleanup(self) -> None:
+        try:
+            self.trigger.detach()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        for j in (self.journal, self.standby_journal):
+            if j is not None:
+                try:
+                    j.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        for b, clk in self._orig_breaker_clocks.items():
+            b._clock = clk
+
+
+class _DyingBinder:
+    """SIGKILL stand-in (the crash e2e's device): the Nth store bind
+    raises a BaseException no retry ladder survives."""
+
+    class LeaderKilled(BaseException):
+        pass
+
+    def __init__(self, store, die_after: int) -> None:
+        from kube_batch_tpu.cache.cache import StoreBinder
+
+        self._inner = StoreBinder(store)
+        self.left = die_after
+
+    def bind(self, pod, hostname: str) -> None:
+        if self.left <= 0:
+            raise _DyingBinder.LeaderKilled()
+        self.left -= 1
+        self._inner.bind(pod, hostname)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+# -- the four scenarios -------------------------------------------------------
+
+
+class MicroVsFull(Scenario):
+    name = "micro_vs_full"
+    describe = (
+        "a gang arrival + micro-cycle drain racing the periodic full "
+        "cycle and its backstop: every schedule must bind the gang "
+        "exactly once, identically"
+    )
+
+    def build(self) -> None:
+        self._wire(nodes=4)
+        self.sched.run_once()  # adopt the resident table
+        self.threads = [
+            [self.s_full("full_cycle"), self.s_full("full_backstop")],
+            [self.s_arrive("g1", 3), self.s_micro()],
+        ]
+
+
+class EventVsInvalidate(Scenario):
+    name = "event_vs_invalidate"
+    describe = (
+        "a node-patch event + arrival + micro racing an external "
+        "resident-table invalidation and the full cycle that re-adopts "
+        "it: the invalid window may skip the micro but never lose the "
+        "arrival or resurrect the dead table"
+    )
+
+    def build(self) -> None:
+        self._wire(nodes=4)
+        self.sched.run_once()
+
+        def patch_node():
+            # same-capacity relabel of an existing node: the patch
+            # flows through trigger -> apply_node_patches without
+            # changing any placement decision (parity stays exact)
+            from kube_batch_tpu.testing import build_node, build_resource_list
+
+            self.store.update_node(
+                build_node(
+                    "n0",
+                    build_resource_list(cpu=16, memory="16Gi", pods=64),
+                    labels={"interleave/patched": "1"},
+                )
+            )
+
+        self.threads = [
+            [
+                Step(
+                    "invalidate_resident",
+                    lambda: self.state.invalidate("external bound churn"),
+                    F_STATE,
+                ),
+                self.s_full("full_readopt"),
+            ],
+            [
+                Step("node_patch_event", patch_node, F_EVENT),
+                self.s_arrive("g1", 3),
+                self.s_micro(),
+            ],
+        ]
+
+
+class TakeoverVsDispatch(Scenario):
+    name = "takeover_vs_dispatch"
+    describe = (
+        "a leader killed mid-micro-dispatch left the journal holding an "
+        "in-flight intent; the standby's reconciliation + full cycle "
+        "race the dead leader's late-landing store write: idempotent "
+        "re-dispatch must converge to the uninterrupted twin with zero "
+        "lost and zero duplicate binds in every order"
+    )
+
+    def build(self) -> None:
+        from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+        from kube_batch_tpu.recovery import WriteIntentJournal, reconcile_journal
+        from kube_batch_tpu.scheduler import Scheduler
+
+        # the uninterrupted twin: one full cycle over the complete world
+        twin = ClusterStore()
+        self._seed(twin, 4)
+        self._arrive(twin, "g0", 6)
+        conf = os.path.join(self.workdir, "twin.yaml")
+        with open(conf, "w", encoding="utf-8") as fh:
+            fh.write(_CONF)
+        Scheduler(SchedulerCache(twin), scheduler_conf=conf).run_once()
+        from kube_batch_tpu.cache.store import PODS
+
+        self.expected = {
+            f"{p.namespace}/{p.name}": p.node_name for p in twin.list(PODS)
+        }
+        if not all(self.expected.values()):
+            raise RuntimeError("model error: twin full cycle left pods unbound")
+
+        # the real run: leader dies on its third inline dispatch
+        self._wire(nodes=4, die_after=2)
+        self.sched.run_once()
+        self._arrive(self.store, "g0", 6)
+        try:
+            self.sched.run_micro(self.trigger.drain())
+        except _DyingBinder.LeaderKilled:
+            pass
+        else:
+            raise RuntimeError("model error: DyingBinder never fired")
+        replay = WriteIntentJournal.replay(self.journal.path)
+        if not replay.orphans:
+            raise RuntimeError("model error: kill left no in-flight intent")
+        orphan = min(replay.orphans, key=lambda i: i.seq)
+        self.standby_journal = WriteIntentJournal(self.journal.path)
+
+        def straggler():
+            # the dead leader's write was already in flight: it lands
+            # late, bound for exactly the journaled node
+            from kube_batch_tpu.cache.cache import StoreBinder
+
+            ns, _, pname = orphan.pod.partition("/")
+            pod = self.store.get_pod(ns, pname)
+            if pod is not None:
+                StoreBinder(self.store).bind(pod, orphan.node)
+
+        def reconcile():
+            reconcile_journal(self.standby_journal, self.store)
+
+        def standby_full():
+            conf2 = os.path.join(self.workdir, "standby.yaml")
+            with open(conf2, "w", encoding="utf-8") as fh:
+                fh.write(_CONF)
+            Scheduler(
+                SchedulerCache(self.store), scheduler_conf=conf2
+            ).run_once()
+
+        self.threads = [
+            [Step("late_dispatch_lands", straggler, F_EVENT)],
+            [
+                Step("takeover_reconcile", reconcile, F_ALL),
+                Step("standby_full_cycle", standby_full, F_EVENT | {L_JOURNAL}),
+            ],
+        ]
+
+    def invariants(self) -> list:
+        out = super().invariants()
+        placed = self.placements()
+        if placed != self.expected:
+            diff = {
+                k: (placed.get(k), self.expected.get(k))
+                for k in set(placed) | set(self.expected)
+                if placed.get(k) != self.expected.get(k)
+            }
+            out.append(f"diverged from the uninterrupted twin: {diff}")
+        return out
+
+
+class Watch410VsDrain(Scenario):
+    name = "watch410_vs_drain"
+    describe = (
+        "a watch client re-listing after 410 Gone re-delivers the "
+        "gang's add events into the dirty feed while the micro drain "
+        "and backstop run: duplicate deliveries must never double-bind "
+        "or lose an arrival"
+    )
+
+    def build(self) -> None:
+        from kube_batch_tpu.cache.store import PODS
+
+        self._wire(nodes=4)
+        self.sched.run_once()
+        self._arrive(self.store, "g0", 3)
+        relisted = [p for p in self.store.list(PODS)]
+
+        def relist_dup():
+            # the re-list window re-emits adds for objects already
+            # delivered — straight into the module dirty feed, exactly
+            # where cache.py publishes store events
+            from kube_batch_tpu.ops import encode_cache
+
+            for p in relisted:
+                encode_cache.note_store_event(PODS, p.metadata.uid, p, None)
+
+        self.threads = [
+            [Step("relist_duplicates", relist_dup, F_TRIG)],
+            [self.s_micro(), self.s_full("full_backstop")],
+        ]
+
+
+# -- the intentionally broken fixture ----------------------------------------
+
+
+def _lossy_trigger():
+    """``drain()`` empties the backlog instead of copy-until-prune —
+    the bug class StreamTrigger.drain's docstring warns about. Exists
+    only so the explorer demonstrably finds and replays a
+    counterexample (trace ``broken_drain:011``)."""
+    from kube_batch_tpu.streaming import StreamTrigger
+
+    class Lossy(StreamTrigger):
+        def drain(self):
+            work = super().drain()
+            with self._lock:
+                self._gangs.clear()  # the bug
+            return work
+
+    return Lossy()
+
+
+class BrokenDrain(Scenario):
+    name = "broken_drain"
+    describe = (
+        "FIXTURE (intentionally broken): a lossy drain() races a "
+        "staleness mark; the schedule where the stale drain precedes "
+        "the serving drain loses the gang from the backlog with no "
+        "full cycle left to save it"
+    )
+    parity = False  # schedules legitimately differ (no backstop)
+
+    @staticmethod
+    def _make_trigger():
+        return _lossy_trigger()
+
+    def build(self) -> None:
+        self._wire(nodes=4)
+        self.sched.run_once()
+        self._arrive(self.store, "g1", 3)
+        self.threads = [
+            [
+                Step(
+                    "mark_stale",
+                    lambda: self.trigger._mark_stale("watch ring overflow"),
+                    F_TRIG,
+                )
+            ],
+            [self.s_micro("drain_micro_1"), self.s_micro("drain_micro_2")],
+        ]
+
+    def invariants(self) -> list:
+        # binding everything is NOT required here (no backstop full
+        # cycle by construction); what is required is that nothing
+        # pending vanished from the backlog
+        from kube_batch_tpu.streaming import gang_key_of
+        from kube_batch_tpu.cache.store import PODS
+
+        out = []
+        pending_gangs = {
+            gang_key_of(p) for p in self.store.list(PODS) if not p.node_name
+        }
+        with self.trigger._lock:
+            backlog = set(self.trigger._gangs)
+        lost = sorted(pending_gangs - backlog)
+        if lost:
+            out.append(
+                f"arrival lost: gang(s) {lost} are pending in the store "
+                "but gone from the trigger backlog — no micro-cycle will "
+                "ever serve them"
+            )
+        dupes = {k: n for k, n in self.bind_counts.items() if n > 1}
+        if dupes:
+            out.append(f"duplicate bind transitions: {dupes}")
+        return out
+
+
+SCENARIOS = {
+    c.name: c for c in (MicroVsFull, EventVsInvalidate, TakeoverVsDispatch, Watch410VsDrain)
+}
+FIXTURES = {BrokenDrain.name: BrokenDrain}
+
+
+# -- explorer -----------------------------------------------------------------
+
+
+def _run_schedule(scn_cls, root: str, order, trace: str, verbose: bool = False) -> ScheduleResult:
+    from kube_batch_tpu import faults
+
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+    scn = scn_cls(tempfile.mkdtemp(prefix="run-", dir=root))
+    result = ScheduleResult(trace=trace, steps=[], violations=[])
+    try:
+        try:
+            scn.build()
+        except Exception as e:  # noqa: BLE001 - a broken builder is a finding
+            result.violations.append(
+                f"model error: scenario build raised {type(e).__name__}: {e}"
+            )
+            return result
+        observed: dict = {}
+        cursor = {"i": -1}
+
+        def on_acquire(name: str) -> None:
+            if cursor["i"] >= 0:
+                observed.setdefault(cursor["i"], set()).add(name)
+
+        scn.witness.on_acquire = on_acquire
+        pos = [0] * len(scn.threads)
+        for i, tid in enumerate(order):
+            step = scn.threads[tid][pos[tid]]
+            pos[tid] += 1
+            cursor["i"] = i
+            t = scn.clock.advance(1.0)
+            try:
+                step.fn()
+            except Exception as e:  # noqa: BLE001 - a raising step is a finding
+                result.violations.append(
+                    f"step {step.name} raised {type(e).__name__}: {e}"
+                )
+                break
+            finally:
+                cursor["i"] = -1
+            result.steps.append((t, tid, step.name))
+            if verbose:
+                print(f"  t={t:>4.0f}  T{tid}  {step.name}")
+            extra = sorted(observed.get(i, set()) - step.footprint)
+            if extra:
+                result.violations.append(
+                    f"model error: step {step.name} acquired undeclared "
+                    f"lock(s) {extra} — footprint under-declared, DPOR "
+                    "pruning would be unsound"
+                )
+        result.violations.extend(scn.witness.violations)
+        result.violations.extend(scn.invariants())
+        if not result.violations:
+            result.fingerprint = scn.fingerprint()
+    finally:
+        scn.cleanup()
+    return result
+
+
+def explore(name: str, root: Optional[str] = None, verbose: bool = False) -> ScenarioReport:
+    """Drive one scenario through every canonical schedule."""
+    scn_cls = SCENARIOS.get(name) or FIXTURES.get(name)
+    if scn_cls is None:
+        raise SystemExit(
+            f"unknown scenario {name!r} (have: "
+            f"{', '.join([*SCENARIOS, *FIXTURES])})"
+        )
+    own_root = root is None
+    root = root or tempfile.mkdtemp(prefix="kbt-interleave-")
+    try:
+        plan_scn = scn_cls(tempfile.mkdtemp(prefix="plan-", dir=root))
+        try:
+            try:
+                plan_scn.build()
+            except Exception as e:  # noqa: BLE001 - broken builder = finding
+                return ScenarioReport(
+                    name=scn_cls.name, describe=scn_cls.describe,
+                    results=[
+                        ScheduleResult(
+                            trace=f"{scn_cls.name}:build", steps=[],
+                            violations=[
+                                "model error: scenario build raised "
+                                f"{type(e).__name__}: {e}"
+                            ],
+                        )
+                    ],
+                )
+            plan = plan_scn.threads
+            orders, pruned = _schedules(plan)
+        finally:
+            plan_scn.cleanup()
+        report = ScenarioReport(
+            name=scn_cls.name, describe=scn_cls.describe,
+            schedules=len(orders), pruned_branches=pruned,
+        )
+        for order in orders:
+            trace = f"{scn_cls.name}:{''.join(str(t) for t in order)}"
+            report.results.append(
+                _run_schedule(scn_cls, root, order, trace, verbose=verbose)
+            )
+        if scn_cls.parity:
+            clean = [r for r in report.results if not r.violations]
+            fps = {json.dumps(r.fingerprint, sort_keys=True) for r in clean}
+            if len(fps) > 1:
+                samples = sorted(
+                    (json.dumps(r.fingerprint, sort_keys=True), r.trace) for r in clean
+                )
+                report.results.append(
+                    ScheduleResult(
+                        trace=f"{scn_cls.name}:parity",
+                        steps=[],
+                        violations=[
+                            "bind-for-bind parity broken across schedules: "
+                            f"{samples[0][1]} and {samples[-1][1]} disagree "
+                            f"on placements"
+                        ],
+                    )
+                )
+        return report
+    finally:
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def replay(trace: str) -> ScheduleResult:
+    """Re-run one schedule by its trace id, verbosely."""
+    name, _, digits = trace.partition(":")
+    scn_cls = SCENARIOS.get(name) or FIXTURES.get(name)
+    if scn_cls is None or not digits or not digits.isdigit():
+        raise SystemExit(f"unknown trace {trace!r} (want <scenario>:<tid digits>)")
+    order = tuple(int(d) for d in digits)
+    root = tempfile.mkdtemp(prefix="kbt-replay-")
+    try:
+        print(f"replaying {trace}:")
+        return _run_schedule(scn_cls, root, order, trace, verbose=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    strict = "--strict" in argv
+    as_json = "--json" in argv
+    do_list = "--list" in argv
+    only = None
+    trace = None
+    if "--scenario" in argv:
+        only = argv[argv.index("--scenario") + 1]
+    if "--replay" in argv:
+        trace = argv[argv.index("--replay") + 1]
+    known = {"--strict", "--json", "--list", "--scenario", "--replay"}
+    unknown = [
+        a for a in argv
+        if a.startswith("--") and a not in known
+    ]
+    if unknown:
+        print(f"unknown option(s): {unknown}", file=sys.stderr)
+        print(__doc__.split("\n\n")[0], file=sys.stderr)
+        return 2
+
+    if do_list:
+        for pool, tag in ((SCENARIOS, ""), (FIXTURES, "  [fixture]")):
+            for name, cls in pool.items():
+                print(f"{name}{tag}: {cls.describe}")
+        return 0
+
+    if trace is not None:
+        r = replay(trace)
+        for v in r.violations:
+            print(f"  VIOLATION: {v}")
+        print(f"replay {trace}: {'FAIL' if r.violations else 'clean'}")
+        return 1 if r.violations else 0
+
+    t0 = time.perf_counter()
+    names = [only] if only else list(SCENARIOS)
+    reports = [explore(n) for n in names]
+    findings = [f for rep in reports for f in rep.findings()]
+
+    repo = repo_root()
+    bl = load_baseline(os.path.join(repo, BASELINE), repo)
+    kept, suppressed, stale = apply_baseline(findings, bl)
+    kept.extend(bl.errors)
+    if strict:
+        kept.extend(stale)
+
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "scenarios": [
+                        {
+                            "name": rep.name,
+                            "schedules": rep.schedules,
+                            "pruned_branches": rep.pruned_branches,
+                            "counterexamples": [
+                                {"trace": r.trace, "violations": r.violations}
+                                for r in rep.counterexamples
+                            ],
+                        }
+                        for rep in reports
+                    ],
+                    "findings": [f.render() for f in kept],
+                    "suppressed": len(suppressed),
+                    "elapsed_s": round(time.perf_counter() - t0, 2),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for rep in reports:
+            status = (
+                "clean" if not rep.counterexamples
+                else f"{len(rep.counterexamples)} counterexample(s)"
+            )
+            print(
+                f"interleave: {rep.name}: {rep.schedules} schedule(s), "
+                f"{rep.pruned_branches} branch(es) pruned, {status}"
+            )
+        for f in kept:
+            print(f.render())
+        print(
+            f"interleave: {sum(r.schedules for r in reports)} schedule(s) "
+            f"across {len(reports)} scenario(s), {len(kept)} finding(s), "
+            f"{len(suppressed)} suppressed, "
+            f"{time.perf_counter() - t0:.1f}s"
+        )
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

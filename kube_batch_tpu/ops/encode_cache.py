@@ -357,6 +357,15 @@ def remove_store_listener(fn) -> None:
             _listeners.remove(fn)
 
 
+def listener_count() -> int:
+    """How many listeners are currently registered. A stopped streaming
+    loop must leave this at its pre-attach value — a leaked listener
+    keeps firing into a dead loop on every store event (KBT-C005's
+    hazard class, pinned by tests/test_streaming.py)."""
+    with _listeners_lock:
+        return len(_listeners)
+
+
 def note_store_event(kind: str, key: str, obj=None, old=None) -> None:
     """Module-level dirty-feed entry point (what cache/cache.py calls).
     ``obj`` is the post-event object (None on delete), ``old`` the

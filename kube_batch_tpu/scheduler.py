@@ -185,11 +185,14 @@ class Scheduler:
         state = streaming.StreamState()
         self._stream_trigger = trigger
         self._stream_state = state
-        trigger.attach()
         log.infof(
             "streaming mode on: micro-cycles between full cycles every %.2fs",
             self.schedule_period,
         )
+        # attach immediately before the try: anything between the
+        # registration and the protecting finally is one exception away
+        # from a leaked listener firing into a dead loop (KBT-C005)
+        trigger.attach()
         try:
             next_full = time.monotonic()  # first full cycle immediately
             while not stop.is_set() and self._streaming_on():

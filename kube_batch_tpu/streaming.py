@@ -143,11 +143,13 @@ class StreamTrigger:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._event = threading.Event()
-        self._gangs: set[str] = set()
-        self._node_patches: dict[str, Optional[object]] = {}
-        self._arrivals: dict[str, float] = {}  # pod uid -> arrival stamp
-        self._stale = False
-        self._stale_reason = ""
+        self._gangs: set[str] = set()  #: guarded_by _lock
+        self._node_patches: dict[str, Optional[object]] = {}  #: guarded_by _lock
+        self._arrivals: dict[str, float] = {}  #: guarded_by _lock  (pod uid -> arrival stamp)
+        self._stale = False  #: guarded_by _lock
+        self._stale_reason = ""  #: guarded_by _lock
+        # _attached is loop-thread-confined (attach/detach both run on
+        # the streaming loop thread), so it stays unguarded on purpose
         self._attached = False
 
     # -- lifecycle -----------------------------------------------------------

@@ -91,6 +91,11 @@ class LockOrderWitness:
         self._tls = threading.local()
         self._edges: dict[tuple[str, str], str] = {}  #: guarded_by _mu
         self.violations: list[str] = []  #: guarded_by _mu
+        # Optional observer called as on_acquire(name) after each
+        # acquisition is recorded. The interleaving model checker
+        # (kube_batch_tpu.analysis.interleave) hangs its step-footprint
+        # recorder here; None costs one attribute read per acquire.
+        self.on_acquire: Callable[[str], None] | None = None
 
     def wrap(self, name: str, lock) -> _WitnessedLock:
         return _WitnessedLock(self, name, lock)
@@ -120,6 +125,8 @@ class LockOrderWitness:
                         if msg not in self.violations:
                             self.violations.append(msg)
         held.append(name)
+        if self.on_acquire is not None:
+            self.on_acquire(name)
 
     def _note_release(self, name: str) -> None:
         held = self._held()

@@ -32,6 +32,7 @@ from kube_batch_tpu.analysis import (
     jax_hazards,
     lock_discipline,
     lock_order,
+    protocol,
     registry_consistency,
     snapshot_escape,
 )
@@ -772,3 +773,204 @@ def test_cli_prune_requires_a_baseline():
         cwd=REPO, capture_output=True, text=True,
     )
     assert res.returncode == 2
+
+
+# -- A6: protocol lifecycles ---------------------------------------------------
+
+C001_LEAKY = '''
+from kube_batch_tpu.framework.session import close_session, open_session
+
+def leaky(cache, tiers, args):
+    ssn = open_session(cache, tiers, args)
+    if not ssn.jobs:
+        return None          # VIOLATION: ssn open on this exit path
+    close_session(ssn)
+    return True
+'''
+
+C001_CLEAN = '''
+from kube_batch_tpu.framework.session import close_session, open_session
+
+def clean(cache, tiers, args):
+    ssn = open_session(cache, tiers, args)
+    try:
+        return len(ssn.jobs)
+    finally:
+        close_session(ssn)
+'''
+
+C001_STMT_LEAKY = '''
+def bail_without_discard(ssn, tasks):
+    stmt = ssn.statement()
+    for t in tasks:
+        if not t.ok:
+            return False     # VIOLATION: neither commit nor discard
+    stmt.commit()
+    return True
+'''
+
+C001_STMT_CLEAN = '''
+def settled_everywhere(ssn, tasks, helper):
+    stmt = ssn.statement()
+    for t in tasks:
+        helper(ssn, stmt, t)  # borrow: passing by argument is not escape
+        if not t.ok:
+            stmt.discard()
+            return False
+    stmt.commit()
+    return True
+'''
+
+
+def test_protocol_c001_session_leak_fires_and_clean_twin_does_not():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/leak.py", C001_LEAKY)])
+    assert codes(findings) == ["KBT-C001"]
+    assert "ssn" in findings[0].message
+    assert protocol.analyze([sf("kube_batch_tpu/x/ok.py", C001_CLEAN)]) == []
+
+
+def test_protocol_c001_statement_leak_fires_and_borrow_is_not_escape():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/stmt.py", C001_STMT_LEAKY)])
+    assert codes(findings) == ["KBT-C001"]
+    assert protocol.analyze([sf("kube_batch_tpu/x/ok.py", C001_STMT_CLEAN)]) == []
+
+
+C002_DISPATCH = '''
+def rogue(cache, task):
+    cache.bind(task, "n1")
+'''
+
+
+def test_protocol_c002_dispatch_scope_is_the_statement_layer():
+    findings = protocol.analyze([sf("kube_batch_tpu/plugins/rogue.py", C002_DISPATCH)])
+    assert codes(findings) == ["KBT-C002"]
+    # the same call inside an owning module is the implementation, not a bypass
+    assert protocol.analyze(
+        [sf("kube_batch_tpu/framework/statement.py", C002_DISPATCH)]
+    ) == []
+
+
+C002_BREAKER = '''
+class Probe:
+    def poke(self, breaker):
+        breaker._transition("OPEN")
+'''
+
+C002_BREAKER_BAD_STATE = '''
+class CircuitBreaker:
+    def _step(self):
+        self._transition("melted")
+'''
+
+
+def test_protocol_c002_breaker_transitions_stay_in_the_ladder():
+    findings = protocol.analyze([sf("kube_batch_tpu/plugins/probe.py", C002_BREAKER)])
+    assert codes(findings) == ["KBT-C002"]
+    # inside the ladder with a declared state: fine
+    ok = C002_BREAKER.replace("class Probe", "class CircuitBreaker").replace(
+        '"OPEN"', '"open"'
+    )
+    assert protocol.analyze([sf("kube_batch_tpu/faults/ladder.py", ok)]) == []
+    # inside the ladder but outside the declared alphabet: still flagged
+    findings = protocol.analyze(
+        [sf("kube_batch_tpu/faults/ladder.py", C002_BREAKER_BAD_STATE)]
+    )
+    assert codes(findings) == ["KBT-C002"]
+
+
+C003_ORPHAN = '''
+def orphan(journal, intents):
+    journal.append_intents(intents)
+    return None
+'''
+
+C003_PAIRED = '''
+def paired(journal, cache, intents):
+    seqs = journal.append_intents(intents)
+    cache._submit_write(seqs)
+    for s in seqs:
+        journal.confirm(s)
+'''
+
+C003_CONFIRM_ONLY = '''
+def confirm_strangers(journal, seqs):
+    for s in seqs:
+        journal.confirm(s)
+'''
+
+
+def test_protocol_c003_append_without_dispatch_or_confirm():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/j.py", C003_ORPHAN)])
+    assert set(codes(findings)) == {"KBT-C003"}
+    assert protocol.analyze([sf("kube_batch_tpu/x/ok.py", C003_PAIRED)]) == []
+
+
+def test_protocol_c003_confirm_without_append_exempts_recovery():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/c.py", C003_CONFIRM_ONLY)])
+    assert codes(findings) == ["KBT-C003"]
+    # takeover legitimately confirms a dead leader's intents
+    assert protocol.analyze(
+        [sf("kube_batch_tpu/recovery/takeover_x.py", C003_CONFIRM_ONLY)]
+    ) == []
+
+
+C004_STALE_READ = '''
+def stale(state, patches):
+    state.invalidate("bound churn")
+    state.apply_node_patches(patches)
+'''
+
+C004_REHARVESTED = '''
+def reharvested(state, ssn, patches):
+    state.invalidate("bound churn")
+    state.adopt_full_cycle(ssn)
+    state.apply_node_patches(patches)
+'''
+
+
+def test_protocol_c004_read_after_invalidate_needs_reharvest():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/s.py", C004_STALE_READ)])
+    assert codes(findings) == ["KBT-C004"]
+    assert protocol.analyze([sf("kube_batch_tpu/x/ok.py", C004_REHARVESTED)]) == []
+
+
+C005_GAP = '''
+def leaky_loop(trigger, stop, prepare, run):
+    trigger.attach()
+    prepare()
+    try:
+        run(stop)
+    finally:
+        trigger.detach()
+'''
+
+C005_TIGHT = '''
+def tight_loop(trigger, stop, prepare, run):
+    prepare()
+    trigger.attach()
+    try:
+        run(stop)
+    finally:
+        trigger.detach()
+'''
+
+C005_CLASS_TEARDOWN = '''
+class Consumer:
+    def start(self):
+        self.trigger.attach()
+
+    def stop(self):
+        self.trigger.detach()
+'''
+
+
+def test_protocol_c005_registration_gap_before_try_fires():
+    findings = protocol.analyze([sf("kube_batch_tpu/x/loop.py", C005_GAP)])
+    assert codes(findings) == ["KBT-C005"]
+    assert protocol.analyze([sf("kube_batch_tpu/x/ok.py", C005_TIGHT)]) == []
+
+
+def test_protocol_c005_class_teardown_pairing_is_clean():
+    assert protocol.analyze(
+        [sf("kube_batch_tpu/x/consumer.py", C005_CLASS_TEARDOWN)]
+    ) == []

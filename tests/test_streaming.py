@@ -234,6 +234,30 @@ def test_stream_state_adopt_patch_invalidate():
 # -- end to end --------------------------------------------------------------
 
 
+def test_stopped_streaming_loop_leaves_zero_listeners(tmp_path):
+    """A streaming loop that has been stopped (cleanly or by exception)
+    must return the store-listener registry to its pre-start count — a
+    leaked listener keeps firing into the dead loop on every store
+    event (KBT-C005's hazard class)."""
+    from kube_batch_tpu.ops import encode_cache
+
+    before = encode_cache.listener_count()
+    store = ClusterStore()
+    seed_cluster(store)
+    _, sched = make_streaming_scheduler(store, tmp_path, streaming=True, period=30.0)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        arrive_gang(store, "g0", members=4)
+        wait_until(lambda: all_bound(store), what="gang g0 bound")
+        assert encode_cache.listener_count() == before + 1
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+    assert encode_cache.listener_count() == before
+
+
 def test_streaming_binds_arrivals_between_full_cycles(tmp_path):
     """With the full-cycle period far longer than the test, everything
     after the initial cycle must bind through micro-cycles."""
