@@ -44,13 +44,22 @@ Runs, in order:
    one micro-cycle actually taken.
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
-(tests/test_faults.py + tests/test_recovery.py — fault drills, the
-crash-consistent failover e2e), and ``kube_batch_tpu.recovery.fsck``
-against a seeded journal fixture (a known half-confirmed WAL must fsck
-clean with the expected orphan count, and ``--strict`` must gate on it).
+(tests/test_faults.py + tests/test_recovery.py + tests/test_federation.py
+— fault drills, the crash-consistent failover e2e, the conflict chaos
+drill), and ``kube_batch_tpu.recovery.fsck`` against a seeded journal
+fixture (a known half-confirmed WAL must fsck clean with the expected
+orphan count, and ``--strict`` must gate on it).
+
+With ``--federation``, the federation gate runs: the wire-path smoke
+(``python -m kube_batch_tpu.federation --json`` — N schedulers over one
+loopback store process, exactly-once binds, fsck-clean union placement,
+parity with a single-scheduler twin) plus a seeded in-process
+two-scheduler conflict drill whose loser must win its refresh-retry and
+leave store truth fsck-clean.
 
 Exit 0 iff every gate is clean.
-Usage:  python hack/verify.py [--strict] [--chaos] [--interleave] [--json]
+Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
+                              [--interleave] [--json]
 
 ``--json`` appends one machine-readable summary line to stdout
 (per-gate pass/fail + finding counts) so bench/CI can record the
@@ -307,6 +316,100 @@ def run_chaos_gate(env: dict) -> bool:
     return ok
 
 
+# The seeded two-scheduler conflict drill: both caches snapshot the
+# same store version, both dispatch onto ONE node — the second dispatch
+# must lose its optimistic check and win the refresh-retry; store truth
+# must end fsck-clean with all six pods bound.
+_FED_DRILL = """
+import json
+from kube_batch_tpu.api.job_info import job_key
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache import ClusterStore
+from kube_batch_tpu.federation import FederatedCache, fsck, shard_index
+from kube_batch_tpu.testing import (
+    build_node, build_pod, build_pod_group, build_queue, build_resource_list,
+)
+
+store = ClusterStore()
+store.create_queue(build_queue("default"))
+store.create_node(
+    build_node("n0", build_resource_list(cpu=16, memory="16Gi", pods=64))
+)
+for g in ("ga", "gb"):
+    store.create_pod_group(build_pod_group(g, min_member=3))
+    for m in range(3):
+        store.create_pod(build_pod(
+            name=f"{g}-p{m}", group_name=g,
+            req=build_resource_list(cpu=1, memory="512Mi"),
+        ))
+caches = {
+    g: FederatedCache(
+        store, shard=shard_index(job_key("default", g), 2), shards=2,
+        shard_key="gang",
+    )
+    for g in ("ga", "gb")
+}
+for c in caches.values():
+    c.snapshot()  # same version: the second dispatch conflicts for real
+for g, c in caches.items():
+    job = c.jobs[job_key("default", g)]
+    pending = list(job.task_status_index[TaskStatus.PENDING].values())
+    c.bind_many([(t, "n0") for t in pending])
+violations = fsck(store)
+bound = sum(1 for p in store.list("pods") if p.node_name)
+ok = not violations and bound == 6
+print(json.dumps({"ok": ok, "bound": bound, "fsck_violations": violations}))
+raise SystemExit(0 if ok else 1)
+"""
+
+
+def run_federation_gate(env: dict) -> dict:
+    """--federation: the wire-path smoke (python -m
+    kube_batch_tpu.federation --json) + the seeded in-process
+    two-scheduler conflict drill above. Returns a summary for --json."""
+    import json
+
+    env = dict(env)
+    # a shard spec or key armed in the shell would skew both halves
+    env.pop("KBT_FEDERATION", None)
+    env.pop("KBT_SHARD_KEY", None)
+    ok = True
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.federation", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    summary: dict = {}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: federation smoke produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    if res.returncode != 0 or not summary.get("ok", False):
+        print(f"verify: federation smoke FAILED ({summary})")
+        ok = False
+    res = subprocess.run(
+        [sys.executable, "-c", _FED_DRILL],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    drill: dict = {}
+    try:
+        drill = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    if res.returncode != 0 or not drill.get("ok", False):
+        print(res.stdout, res.stderr, sep="\n")
+        print(f"verify: federation two-scheduler conflict drill FAILED ({drill})")
+        ok = False
+    return {
+        "ok": ok,
+        "shards": summary.get("shards"),
+        "bound": summary.get("bound"),
+        "exactly_once": summary.get("exactly_once"),
+        "union_parity": summary.get("union_parity"),
+        "drill_bound": drill.get("bound"),
+    }
+
+
 def run_analysis_gate(strict: bool) -> dict:
     """The domain-aware suite as a subprocess (same pattern as the fsck
     gate: the CLI is the contract). Returns a summary dict for --json."""
@@ -428,9 +531,11 @@ def main(argv: list[str] | None = None) -> int:
     chaos = "--chaos" in argv
     as_json = "--json" in argv
     interleave = "--interleave" in argv
+    federation = "--federation" in argv
     unknown = [
         a for a in argv
-        if a not in ("--strict", "--chaos", "--json", "--interleave")
+        if a not in ("--strict", "--chaos", "--json", "--interleave",
+                     "--federation")
     ]
     if unknown:
         print(f"verify: unknown argument(s): {' '.join(unknown)}")
@@ -584,6 +689,13 @@ def main(argv: list[str] | None = None) -> int:
         print(res.stdout, res.stderr, sep="\n")
         print("verify: streaming smoke FAILED")
         failed = True
+
+    # 7c. --federation: the wire-path smoke + the seeded two-scheduler
+    # conflict drill (optimistic concurrency over the extracted backend)
+    if federation:
+        gates["federation"] = run_federation_gate(env)
+        if not gates["federation"]["ok"]:
+            failed = True
 
     # 8. --chaos: the full chaos-marked suite + fsck on a seeded journal
     if chaos:

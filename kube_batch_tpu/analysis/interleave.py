@@ -44,9 +44,10 @@ counterexample's trace id is its replay seed:
 ``python -m kube_batch_tpu.analysis.interleave --replay broken_drain:011``
 re-runs exactly that schedule step by step, verbosely.
 
-The four default scenarios (ISSUE 9): ``micro_vs_full``,
-``event_vs_invalidate``, ``takeover_vs_dispatch``,
-``watch410_vs_drain``. The intentionally broken fixture
+The five default scenarios: ``micro_vs_full``, ``event_vs_invalidate``,
+``takeover_vs_dispatch``, ``watch410_vs_drain`` (ISSUE 9), and
+``two_scheduler_conflict`` (ISSUE 10 — two federated schedulers racing
+optimistic gang dispatches onto one node). The intentionally broken fixture
 ``broken_drain`` (a trigger whose ``drain()`` empties the backlog
 instead of copy-until-prune) is excluded from the default set; it
 exists so the seeded-counterexample loop stays demonstrably alive —
@@ -605,6 +606,135 @@ class Watch410VsDrain(Scenario):
         ]
 
 
+class TwoSchedulerConflict(Scenario):
+    name = "two_scheduler_conflict"
+    describe = (
+        "two federated schedulers snapshot the same store version and "
+        "race gang dispatches onto ONE node: whichever dispatch lands "
+        "second must lose its optimistic check (stale_node), refresh "
+        "its snapshot version and win the retry — every schedule ends "
+        "with both gangs bound exactly once, zero journal orphans on "
+        "either journal, identical placements, no in-place mutations"
+    )
+
+    # every step contends on the store lock, so nothing prunes: all six
+    # interleavings of {snap,bind} x {A,B} run
+    L_CACHE_A = "cache_a._mutex"
+    L_CACHE_B = "cache_b._mutex"
+    L_JOURNAL_A = "journal_a._lock"
+    L_JOURNAL_B = "journal_b._lock"
+
+    def build(self) -> None:
+        from kube_batch_tpu.cache import ClusterStore
+        from kube_batch_tpu.cache.store import PODS, EventHandler
+        from kube_batch_tpu.faults.mutation_detector import MutationDetector
+        from kube_batch_tpu.federation import FederatedCache
+        from kube_batch_tpu.recovery import WriteIntentJournal
+        from kube_batch_tpu.utils.locking import LockOrderWitness
+
+        self.store = ClusterStore()
+        self._seed(self.store, nodes=1)  # one node: every dispatch collides
+        self.bind_counts: dict = {}
+
+        def on_update(old, new):
+            if not old.node_name and new.node_name:
+                key = f"{new.namespace}/{new.name}"
+                self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+
+        self.store.add_event_handler(PODS, EventHandler(on_update=on_update))
+        self._arrive(self.store, "ga", 3)
+        self._arrive(self.store, "gb", 3)
+        self.journal = WriteIntentJournal(os.path.join(self.workdir, "a.wal"))
+        self.standby_journal = WriteIntentJournal(
+            os.path.join(self.workdir, "b.wal")
+        )
+        # gang-keyed shards chosen so ga -> A, gb -> B deterministically
+        # (crc32 is stable); no writer pools: each bind step IS its
+        # conditional store transaction, retries included
+        self.cache_a = self._shard_cache_for("ga", self.journal)
+        self.cache_b = self._shard_cache_for("gb", self.standby_journal)
+        self.detector = MutationDetector(self.store)
+        self.detector.snapshot()
+
+        self.witness = LockOrderWitness()
+        self.store._lock = self.witness.wrap(L_STORE, self.store._lock)
+        self.cache_a._mutex = self.witness.wrap(self.L_CACHE_A, self.cache_a._mutex)
+        self.cache_b._mutex = self.witness.wrap(self.L_CACHE_B, self.cache_b._mutex)
+        self.journal._lock = self.witness.wrap(self.L_JOURNAL_A, self.journal._lock)
+        self.standby_journal._lock = self.witness.wrap(
+            self.L_JOURNAL_B, self.standby_journal._lock
+        )
+
+        f_snap_a = frozenset({L_STORE, self.L_CACHE_A})
+        f_snap_b = frozenset({L_STORE, self.L_CACHE_B})
+        # a dispatch touches everything: its own mutex + journal, the
+        # store, AND the peer cache (the commit's update events fan out
+        # to the peer's informer handlers synchronously)
+        f_bind_a = frozenset(
+            {L_STORE, self.L_CACHE_A, self.L_CACHE_B, self.L_JOURNAL_A}
+        )
+        f_bind_b = frozenset(
+            {L_STORE, self.L_CACHE_A, self.L_CACHE_B, self.L_JOURNAL_B}
+        )
+        self.threads = [
+            [
+                Step("snapshot_a", lambda: self.cache_a.snapshot(), f_snap_a),
+                Step("dispatch_a", lambda: self._bind_gang(self.cache_a, "ga"), f_bind_a),
+            ],
+            [
+                Step("snapshot_b", lambda: self.cache_b.snapshot(), f_snap_b),
+                Step("dispatch_b", lambda: self._bind_gang(self.cache_b, "gb"), f_bind_b),
+            ],
+        ]
+
+    def _shard_cache_for(self, gang: str, journal):
+        """A FederatedCache whose shard is whichever bucket ``gang``
+        hashes into (2 shards, gang key) — the scenario stays valid if
+        crc32's bucket assignment ever changes."""
+        from kube_batch_tpu.federation import FederatedCache, shard_index
+        from kube_batch_tpu.api.job_info import job_key
+
+        shard = shard_index(job_key("default", gang), 2)
+        return FederatedCache(
+            self.store, shard=shard, shards=2, shard_key="gang", journal=journal
+        )
+
+    @staticmethod
+    def _bind_gang(cache, gang: str) -> None:
+        from kube_batch_tpu.api.job_info import job_key
+        from kube_batch_tpu.api.types import TaskStatus
+
+        uid = job_key("default", gang)
+        with cache._mutex:
+            job = cache.jobs.get(uid)
+            pending = (
+                list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
+                if job is not None
+                else []
+            )
+        if not pending:
+            raise RuntimeError(f"model error: gang {gang} has no pending tasks")
+        cache.bind_many([(t, "n0") for t in pending])
+
+    def invariants(self) -> list:
+        out = super().invariants()
+        from kube_batch_tpu.recovery import WriteIntentJournal
+
+        orphans = WriteIntentJournal.replay(self.standby_journal.path).orphans
+        if orphans:
+            out.append(
+                "scheduler B's journal left with unconfirmed intents: "
+                + ", ".join(f"{i.op} {i.pod} seq={i.seq}" for i in orphans)
+            )
+        mutated = self.detector.violations()
+        if mutated:
+            out.append(f"in-place mutation of store objects: {mutated}")
+        from kube_batch_tpu.federation import fsck
+
+        out.extend(fsck(self.store))
+        return out
+
+
 # -- the intentionally broken fixture ----------------------------------------
 
 
@@ -681,7 +811,14 @@ class BrokenDrain(Scenario):
 
 
 SCENARIOS = {
-    c.name: c for c in (MicroVsFull, EventVsInvalidate, TakeoverVsDispatch, Watch410VsDrain)
+    c.name: c
+    for c in (
+        MicroVsFull,
+        EventVsInvalidate,
+        TakeoverVsDispatch,
+        Watch410VsDrain,
+        TwoSchedulerConflict,
+    )
 }
 FIXTURES = {BrokenDrain.name: BrokenDrain}
 

@@ -1,0 +1,130 @@
+"""Full-fidelity wire codec for the store-backend protocol.
+
+The scheduler HTTP endpoints in ``server.py`` serialize objects for
+*observability* — a pod on ``/apis/v1alpha1/pods`` carries only
+namespace/name/phase/node. A networked store backend
+(``cache/backend.py``) needs the whole object back: requests, gang
+annotations, affinity, tolerations — everything the solve reads. This
+module is that codec: a generic recursive encoder/decoder over the
+``apis/types.py`` dataclasses, driven by field type hints, so a new
+field on any API type rides the wire without touching this file.
+
+Encoding rules: dataclass -> dict of encoded fields, str-Enum -> its
+value, dict -> encoded values (keys stay strings), list/tuple -> JSON
+array, scalars/None pass through. Decoding inverts field-by-field from
+the declared type; unknown wire fields are ignored (forward
+compatibility) and missing ones fall back to the dataclass default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from enum import Enum
+from typing import Any, Optional, Union
+
+from kube_batch_tpu.apis import types as api_types
+
+__all__ = ["KIND_TYPES", "to_wire", "from_wire", "decode_kind", "encode_kind"]
+
+# kind name (cache/store.py KINDS) -> dataclass; string keys on purpose:
+# apis/ sits below cache/ in the layering and must not import it.
+KIND_TYPES: dict[str, type] = {
+    "pods": api_types.Pod,
+    "nodes": api_types.Node,
+    "podgroups": api_types.PodGroup,
+    "queues": api_types.Queue,
+    "poddisruptionbudgets": api_types.PodDisruptionBudget,
+    "priorityclasses": api_types.PriorityClass,
+    "persistentvolumes": api_types.PersistentVolume,
+    "persistentvolumeclaims": api_types.PersistentVolumeClaim,
+    "storageclasses": api_types.StorageClass,
+    "leases": api_types.Lease,
+}
+
+_hints_cache: dict[type, dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> dict[str, Any]:
+    hints = _hints_cache.get(cls)
+    if hints is None:
+        # types.py uses `from __future__ import annotations`: field types
+        # are strings until resolved against the defining module
+        hints = typing.get_type_hints(cls, vars(api_types))
+        _hints_cache[cls] = hints
+    return hints
+
+
+def to_wire(obj: Any) -> Any:
+    """Encode any API object (or nested fragment) to JSON-able data."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: to_wire(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def from_wire(hint: Any, data: Any) -> Any:
+    """Decode wire data back into the shape ``hint`` declares."""
+    origin = typing.get_origin(hint)
+    if origin is Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if data is None:
+            return None
+        return from_wire(args[0], data) if args else data
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        if data is None:
+            return [] if origin is list else ()
+        if origin is list:
+            inner = args[0] if args else Any
+            return [from_wire(inner, v) for v in data]
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(from_wire(args[0], v) for v in data)
+        return tuple(
+            from_wire(args[i] if i < len(args) else Any, v)
+            for i, v in enumerate(data)
+        )
+    if origin is dict:
+        args = typing.get_args(hint)
+        inner = args[1] if len(args) == 2 else Any
+        return {k: from_wire(inner, v) for k, v in (data or {}).items()}
+    if isinstance(hint, type) and issubclass(hint, Enum):
+        return hint(data)
+    if isinstance(hint, type) and dataclasses.is_dataclass(hint):
+        if data is None:
+            return None
+        hints = _hints(hint)
+        names = {f.name for f in dataclasses.fields(hint)}
+        kwargs = {
+            k: from_wire(hints.get(k, Any), v)
+            for k, v in data.items()
+            if k in names
+        }
+        return hint(**kwargs)
+    return data
+
+
+def decode_kind(kind: str, data: dict) -> Any:
+    """Decode one wire object of the named store kind."""
+    cls = KIND_TYPES.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown wire kind {kind!r}")
+    return from_wire(cls, data)
+
+
+def encode_kind(kind: str, obj: Any) -> Optional[dict]:
+    """Encode one store object of the named kind (None passes through —
+    watch deletes carry no new object)."""
+    if obj is None:
+        return None
+    if kind not in KIND_TYPES:
+        raise KeyError(f"unknown wire kind {kind!r}")
+    return to_wire(obj)

@@ -1,5 +1,11 @@
 """L2: the event-driven cluster cache (reference pkg/scheduler/cache)."""
 
+from kube_batch_tpu.cache.backend import (
+    BackendPartitioned,
+    InProcessBackend,
+    LoopbackBackend,
+    StoreBackend,
+)
 from kube_batch_tpu.cache.cache import (
     NoopVolumeBinder,
     SchedulerCache,
@@ -20,11 +26,17 @@ from kube_batch_tpu.cache.store import (
     QUEUES,
     ClusterStore,
     EventHandler,
+    StaleWrite,
 )
 
 __all__ = [
+    "BackendPartitioned",
     "ClusterStore",
     "EventHandler",
+    "InProcessBackend",
+    "LoopbackBackend",
+    "StaleWrite",
+    "StoreBackend",
     "KINDS",
     "NODES",
     "NoopVolumeBinder",

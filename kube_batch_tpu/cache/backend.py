@@ -1,0 +1,452 @@
+"""Store backends: the ClusterStore surface behind an interface.
+
+PR 10 tentpole (ISSUE.md): everything SchedulerCache needs from "the
+cluster" is a narrow surface — subscribe (``add_event_handler`` with
+initial replay), read (``get`` / ``list`` / ``get_pod``), write
+(``update_pod`` / ``delete_pod`` / ``update_pod_group`` /
+``update_persistent_volume`` / ``update_persistent_volume_claim``),
+optimistic transactions (``conditional_bind_many`` /
+``conditional_evict``) and the monotonic ``version`` those transactions
+are checked against. This module names that surface (``StoreBackend``)
+and provides both implementations:
+
+- ``InProcessBackend``: the ClusterStore itself (zero behavior change —
+  the single-process fast path every existing test runs on);
+- ``LoopbackBackend``: the same surface over the scheduler server's
+  ``/backend/v1/`` HTTP protocol — full-fidelity wire objects
+  (apis/wire.py), list+watch with per-kind cursors and the 410-Gone
+  re-list contract, and conditional writes whose 409 replies are
+  reconstructed into the same typed ``StaleWrite`` the in-process store
+  raises, so the cache's conflict dispatch is backend-agnostic.
+
+Federation (federation.py) runs N schedulers, each over its own
+LoopbackBackend against one shared store process: Omega-style shared
+state with optimistic concurrency instead of pessimistic partitioning.
+
+The mirror is pulled, not pushed: ``pump()`` executes one deterministic
+poll pass over every subscribed kind (tests and the interleave explorer
+call it explicitly; ``start()`` runs it on a background thread for real
+deployments). Staleness is first-class — ``snapshot_age()`` reports
+seconds since the last fully-successful pump, and the cache's
+refuse-to-schedule guard (KBT_MAX_SNAPSHOT_AGE_S) consumes it via the
+``staleness_fn`` hook.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu.apis import wire
+from kube_batch_tpu.cache.store import (
+    KINDS,
+    NODES,
+    PODS,
+    PRIORITY_CLASSES,
+    PVCS,
+    PVS,
+    POD_GROUPS,
+    QUEUES,
+    ClusterStore,
+    EventHandler,
+    StaleWrite,
+    obj_key,
+)
+
+__all__ = [
+    "StoreBackend",
+    "InProcessBackend",
+    "LoopbackBackend",
+    "BackendPartitioned",
+]
+
+
+class BackendPartitioned(ConnectionError):
+    """The store backend is unreachable (real transport failure or the
+    ``federation.partition`` fault). Transient by contract: the cache's
+    ``_write_with_retry`` retries it, the pump skips the round and lets
+    ``snapshot_age`` grow until the partition heals."""
+
+
+class StoreBackend:
+    """The surface SchedulerCache (and its default write-side helpers)
+    requires from a cluster store. Documentation-by-interface: both
+    implementations duck-type it, nothing isinstance-checks it.
+
+    Required:
+      add_event_handler(kind, EventHandler)  # + initial-list replay
+      get(kind, key) / list(kind) / get_pod(namespace, name)
+      update_pod(pod) / delete_pod(namespace, name)
+      update_pod_group(pg)
+      update_persistent_volume(pv) / update_persistent_volume_claim(pvc)
+      conditional_bind_many(bindings, snapshot_version) -> applied pods
+      conditional_evict(namespace, name, snapshot_version)
+      version  # monotonic store version (int property)
+    """
+
+
+class InProcessBackend(ClusterStore):
+    """The in-process store IS the backend — the single-process fast
+    path. A distinct class (rather than an alias) so deployments can
+    name which backend they constructed in logs and bench rows."""
+
+
+class LoopbackBackend:
+    """StoreBackend over the scheduler server's ``/backend/v1/`` HTTP
+    protocol (server.py). Reads come from a local mirror fed by
+    full-fidelity list+watch; writes go over the wire; conditional
+    writes re-raise the server's typed 409 as ``StaleWrite``."""
+
+    def __init__(
+        self,
+        base_url: str,
+        kinds: tuple = KINDS,
+        timeout: float = 5.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.kinds = tuple(kinds)
+        self.timeout = timeout
+        self._lock = threading.RLock()
+        self._mirror: dict[str, dict[str, Any]] = {k: {} for k in self.kinds}
+        self._handlers: dict[str, list[EventHandler]] = {k: [] for k in self.kinds}
+        # Per-kind watch cursor: the server's rv is a global sequence but
+        # rings are per kind, so a cursor advanced by one kind's poll must
+        # never be reused for another kind (it would skip that kind's
+        # events below it).
+        self._cursor: dict[str, int] = {k: 0 for k in self.kinds}
+        self._synced: dict[str, bool] = {k: False for k in self.kinds}
+        # Last storeVersion any reply carried: the `version` property's
+        # fallback when the backend is partitioned (snapshot() must not
+        # fail just because version couldn't be refreshed).
+        self._store_version = 0
+        self._last_pump_ok = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, op: str, method: str, path: str, body: Optional[dict] = None):
+        """One metered round-trip. Raises BackendPartitioned on transport
+        failure (injected or real), StaleWrite on a conflict 409."""
+        if faults.should_fire("federation.partition"):
+            raise BackendPartitioned(
+                f"federation.partition: injected transport drop ({op})"
+            )
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        start = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                payload = {}
+            if e.code == 409 and "conflict" in payload:
+                c = payload["conflict"]
+                raise StaleWrite(
+                    c.get("kind", ""),
+                    c.get("key", ""),
+                    c.get("reason", "conflict"),
+                    int(c.get("expected", 0)),
+                    int(c.get("actual", 0)),
+                ) from None
+            if e.code == 410:
+                raise _Gone(int(payload.get("resourceVersion", 0))) from None
+            raise BackendPartitioned(f"{op}: HTTP {e.code}") from e
+        except OSError as e:  # connection refused/reset, timeout
+            raise BackendPartitioned(f"{op}: {e}") from e
+        finally:
+            metrics.observe_store_backend_rtt(op, time.perf_counter() - start)
+        if isinstance(payload, dict) and "storeVersion" in payload:
+            with self._lock:
+                self._store_version = max(
+                    self._store_version, int(payload["storeVersion"])
+                )
+        return payload
+
+    # -- subscribe ---------------------------------------------------------
+
+    def add_event_handler(self, kind: str, handler: EventHandler) -> None:
+        """Register + initial replay of the current mirror, matching the
+        in-process store's informer contract. The first subscription of a
+        kind lists it over the wire to seed the mirror."""
+        with self._lock:
+            synced = self._synced[kind]
+        listing = None if synced else self._fetch_list(kind)
+        with self._lock:
+            if listing is not None and not self._synced[kind]:
+                self._mirror[kind], self._cursor[kind] = listing
+                self._synced[kind] = True
+            self._handlers[kind].append(handler)
+            replay = list(self._mirror[kind].values())
+        for obj in replay:
+            handler.add(obj)
+
+    def _fetch_list(self, kind: str) -> tuple[dict, int]:
+        """Blocking list over the wire — never called under _lock (the
+        round trip can stall for the full transport timeout)."""
+        payload = self._request("list", "GET", f"/backend/v1/{kind}")
+        mirror = {
+            obj_key(kind, obj): obj
+            for obj in (wire.decode_kind(kind, d) for d in payload["items"])
+        }
+        # rv was read BEFORE the server listed: resuming the watch from it
+        # re-delivers anything concurrent with the list (at-least-once);
+        # redelivery is diffed against the mirror, so it degrades to a
+        # no-op update, never a lost event.
+        return mirror, int(payload["resourceVersion"])
+
+    # -- pump (watch -> mirror -> handlers) --------------------------------
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """One deterministic poll pass over every subscribed kind;
+        returns the number of events dispatched. A partition skips the
+        round (mirror stales, snapshot_age grows) instead of raising."""
+        dispatched = 0
+        try:
+            for kind in self.kinds:
+                with self._lock:
+                    if not self._synced[kind]:
+                        continue
+                    since = self._cursor[kind]
+                try:
+                    payload = self._request(
+                        "watch",
+                        "GET",
+                        f"/backend/v1/watch/{kind}?since={since}&timeout={timeout}",
+                    )
+                except _Gone:
+                    # 410: our cursor fell out of the ring — re-list and
+                    # synthesize the diff so handlers still see every
+                    # transition exactly once from their point of view.
+                    dispatched += self._relist(kind)
+                    continue
+                events = payload.get("events", [])
+                batch: list[tuple] = []
+                with self._lock:
+                    for ev in events:
+                        obj = wire.decode_kind(kind, ev["object"])
+                        key = obj_key(kind, obj)
+                        old = self._mirror[kind].get(key)
+                        if ev["type"] == "DELETED":
+                            if old is not None:
+                                del self._mirror[kind][key]
+                                batch.append(("delete", old, None))
+                        elif old is None:
+                            self._mirror[kind][key] = obj
+                            batch.append(("add", None, obj))
+                        else:
+                            self._mirror[kind][key] = obj
+                            batch.append(("update", old, obj))
+                    self._cursor[kind] = int(payload["resourceVersion"])
+                    handlers = list(self._handlers[kind])
+                dispatched += self._dispatch(handlers, batch)
+        except BackendPartitioned as e:
+            log.V(3).infof("backend pump skipped: %s", e)
+            return dispatched
+        self._last_pump_ok = time.monotonic()
+        return dispatched
+
+    def _relist(self, kind: str) -> int:
+        """410 heal: list, diff against the mirror, dispatch the delta."""
+        after, rv = self._fetch_list(kind)
+        with self._lock:
+            before = dict(self._mirror[kind])
+            self._mirror[kind] = after
+            self._cursor[kind] = rv
+            self._synced[kind] = True
+            handlers = list(self._handlers[kind])
+            batch: list[tuple] = []
+            for key, obj in after.items():
+                old = before.get(key)
+                if old is None:
+                    batch.append(("add", None, obj))
+                elif old is not obj:
+                    batch.append(("update", old, obj))
+            for key, old in before.items():
+                if key not in after:
+                    batch.append(("delete", old, None))
+        return self._dispatch(handlers, batch)
+
+    @staticmethod
+    def _dispatch(handlers: list[EventHandler], batch: list[tuple]) -> int:
+        for verb, old, new in batch:
+            for h in handlers:
+                if verb == "add":
+                    h.add(new)
+                elif verb == "update":
+                    h.update(old, new)
+                else:
+                    h.delete(old)
+        return len(batch)
+
+    def start(self, period: float = 0.2) -> None:
+        """Background pump for real deployments (tests call pump())."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                self.pump(timeout=period)
+
+        self._thread = threading.Thread(target=loop, name="kb-backend", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def snapshot_age(self) -> float:
+        """Seconds since the last fully-successful pump — the
+        staleness_fn the cache's refuse-to-schedule guard reads."""
+        return max(0.0, time.monotonic() - self._last_pump_ok)
+
+    # -- reads (mirror) ----------------------------------------------------
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._mirror[kind].get(key)
+
+    def list(self, kind: str) -> list[Any]:
+        with self._lock:
+            return list(self._mirror[kind].values())
+
+    def get_pod(self, namespace: str, name: str):
+        return self.get(PODS, f"{namespace}/{name}")
+
+    # -- writes (wire) -----------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        """Current store version; last-seen fallback under partition so
+        snapshot() keeps working while the transport heals (a stale
+        version only makes this scheduler's next dispatch MORE likely to
+        lose a conflict — safe by construction)."""
+        try:
+            payload = self._request("version", "GET", "/backend/v1/version")
+            return int(payload["storeVersion"])
+        except (BackendPartitioned, StaleWrite, KeyError, ValueError):
+            with self._lock:
+                return self._store_version
+
+    def conditional_bind_many(
+        self, bindings: list[tuple[str, str, str]], snapshot_version: int
+    ) -> int:
+        payload = self._request(
+            "bind",
+            "POST",
+            "/backend/v1/bind",
+            {"bindings": [list(b) for b in bindings],
+             "snapshotVersion": snapshot_version},
+        )
+        return int(payload.get("applied", 0))
+
+    def conditional_evict(self, namespace: str, name: str, snapshot_version: int):
+        payload = self._request(
+            "evict",
+            "POST",
+            "/backend/v1/evict",
+            {"namespace": namespace, "name": name,
+             "snapshotVersion": snapshot_version},
+        )
+        return payload.get("evicted")
+
+    def _crud(self, kind: str, verb: str, obj=None, key: Optional[str] = None) -> None:
+        body: dict[str, Any] = {"verb": verb}
+        if obj is not None:
+            body["object"] = wire.encode_kind(kind, obj)
+        if key is not None:
+            body["key"] = key
+        self._request(f"{verb}.{kind}", "POST", f"/backend/v1/{kind}", body)
+
+    def create(self, kind: str, obj) -> Any:
+        self._crud(kind, "create", obj)
+        return obj
+
+    def update(self, kind: str, obj) -> Any:
+        self._crud(kind, "update", obj)
+        return obj
+
+    def delete(self, kind: str, key: str) -> None:
+        self._crud(kind, "delete", key=key)
+
+    def update_pod(self, pod) -> Any:
+        return self.update(PODS, pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.delete(PODS, f"{namespace}/{name}")
+
+    def create_pod(self, pod) -> Any:
+        return self.create(PODS, pod)
+
+    def update_pod_group(self, pg) -> Any:
+        return self.update(POD_GROUPS, pg)
+
+    def update_persistent_volume(self, pv) -> Any:
+        return self.update(PVS, pv)
+
+    def update_persistent_volume_claim(self, pvc) -> Any:
+        return self.update(PVCS, pvc)
+
+    # The typed conveniences the server's workload API handler calls, so
+    # a federated scheduler's own HTTP endpoint proxies mutations through
+    # to the store process instead of 500ing on a missing method.
+
+    def create_queue(self, q) -> Any:
+        return self.create(QUEUES, q)
+
+    def delete_queue(self, name: str) -> None:
+        self.delete(QUEUES, name)
+
+    def create_node(self, n) -> Any:
+        return self.create(NODES, n)
+
+    def delete_node(self, name: str) -> None:
+        self.delete(NODES, name)
+
+    def create_pod_group(self, pg) -> Any:
+        return self.create(POD_GROUPS, pg)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        self.delete(POD_GROUPS, f"{namespace}/{name}")
+
+    def create_priority_class(self, pc) -> Any:
+        return self.create(PRIORITY_CLASSES, pc)
+
+    def delete_priority_class(self, name: str) -> None:
+        self.delete(PRIORITY_CLASSES, name)
+
+    def create_persistent_volume(self, pv) -> Any:
+        return self.create(PVS, pv)
+
+    def delete_persistent_volume(self, name: str) -> None:
+        self.delete(PVS, name)
+
+    def create_persistent_volume_claim(self, pvc) -> Any:
+        return self.create(PVCS, pvc)
+
+    def delete_persistent_volume_claim(self, namespace: str, name: str) -> None:
+        self.delete(PVCS, f"{namespace}/{name}")
+
+
+class _Gone(Exception):
+    """Internal: the watch cursor fell behind the server ring (410)."""
+
+    def __init__(self, rv: int) -> None:
+        super().__init__(f"410 gone (rv {rv})")
+        self.rv = rv

@@ -67,6 +67,7 @@ from kube_batch_tpu.cache.store import (
     STORAGE_CLASSES,
     ClusterStore,
     EventHandler,
+    StaleWrite,
 )
 from kube_batch_tpu.utils.locking import assume_locked
 from kube_batch_tpu.utils.workqueue import RateLimitingQueue
@@ -151,6 +152,13 @@ class StoreBinder:
         bound = dataclasses.replace(pod, node_name=hostname)
         self._store.update_pod(bound)
 
+    def bind_many_versioned(
+        self, bindings: list[tuple[str, str, str]], snapshot_version: int
+    ) -> None:
+        """Optimistic gang transaction: all entries commit or the store
+        raises StaleWrite (federation dispatch path, one gang per call)."""
+        self._store.conditional_bind_many(bindings, snapshot_version)
+
 
 class StoreEvictor:
     """Default Evictor: deletes the pod from the store (the reference's
@@ -162,6 +170,15 @@ class StoreEvictor:
     def evict(self, pod: Pod) -> None:
         log.V(3).infof("Evicting pod %s/%s", pod.namespace, pod.name)
         self._store.delete_pod(pod.namespace, pod.name)
+
+    def evict_versioned(self, pod: Pod, snapshot_version: int) -> None:
+        """Optimistic evict: rejected with StaleWrite when the pod's node
+        took a placement write the snapshot never saw."""
+        log.V(3).infof(
+            "Evicting pod %s/%s (snapshot v%d)",
+            pod.namespace, pod.name, snapshot_version,
+        )
+        self._store.conditional_evict(pod.namespace, pod.name, snapshot_version)
 
 
 class StoreStatusUpdater:
@@ -442,6 +459,7 @@ class SchedulerCache:
         volume_binder=None,
         journal=None,
         staleness_fn=None,
+        conditional_binds: Optional[bool] = None,
     ) -> None:
         self._mutex = threading.RLock()
         self.store = store
@@ -496,6 +514,31 @@ class SchedulerCache:
                 os.environ.get("KBT_RESYNC_MAX_RETRIES"),
             )
             self._resync_max_retries = 15
+        # Omega-style optimistic dispatch (federation): bulk binds and
+        # evicts go through the store's conditional transactions, one
+        # gang per transaction, carrying the snapshot's store version.
+        # A StaleWrite loser refreshes its version and retries up to
+        # KBT_CONFLICT_MAX_RETRIES times with jittered backoff; a
+        # terminal loser accepts store truth (confirm the intent, resync
+        # the gang's tasks). On by default when KBT_FEDERATION is set;
+        # federation.py passes conditional_binds=True explicitly.
+        if conditional_binds is None:
+            conditional_binds = bool(os.environ.get("KBT_FEDERATION", ""))
+        self._conditional_binds = conditional_binds
+        try:
+            self._conflict_max_retries = max(
+                0, int(os.environ.get("KBT_CONFLICT_MAX_RETRIES", "3"))
+            )
+        except ValueError:
+            log.errorf(
+                "KBT_CONFLICT_MAX_RETRIES=%r is not an integer; using 3",
+                os.environ.get("KBT_CONFLICT_MAX_RETRIES"),
+            )
+            self._conflict_max_retries = 3
+        # Store version this cache's latest snapshot solved over — the
+        # version every conditional dispatch carries (#: guarded_by _mutex
+        # for writes; dispatch reads the int atomically).
+        self._snapshot_version = 0
         self._writer: Optional[ThreadPoolExecutor] = None
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -1017,8 +1060,78 @@ class SchedulerCache:
         )
 
     def _do_bind_many(self, resolved: list) -> None:
+        if self._conditional_binds and hasattr(self.binder, "bind_many_versioned"):
+            # one optimistic transaction per gang: a gang commits whole
+            # or loses whole, so the conflict loser re-solves a complete
+            # gang instead of reconciling a half-bound one
+            gangs: dict[str, list] = {}
+            for entry in resolved:
+                gangs.setdefault(entry[2].job, []).append(entry)
+            for gang in gangs.values():
+                self._do_bind_gang(gang)
+            return
         for pod, hostname, task, seq in resolved:
             self._do_bind(pod, hostname, task, seq)
+
+    def _do_bind_gang(self, entries: list) -> None:
+        """Dispatch one gang as a conditional store transaction carrying
+        the snapshot version (Omega optimistic concurrency). On
+        StaleWrite the loser refreshes its version and retries with
+        jittered backoff; past KBT_CONFLICT_MAX_RETRIES it accepts store
+        truth — the journal intents are confirmed (the conflict resolved
+        them: the winning placement stands) and the gang's tasks resync
+        from the store, re-solving next cycle. This is reconcile_journal's
+        takeover-time "store truth wins" rule applied per cycle."""
+        bindings = [
+            (pod.namespace, pod.name, hostname)
+            for pod, hostname, _task, _seq in entries
+        ]
+        version = self._snapshot_version
+        if faults.should_fire("federation.stale_assign"):
+            version = 0  # deliberately ancient: forces the conflict path
+        what = f"gang <{entries[0][2].job}> ({len(entries)} pod(s))"
+        delay = 0.02
+        conflicts = 0
+        while True:
+            try:
+                self._write_with_retry(
+                    "bind",
+                    what,
+                    lambda v=version: self.binder.bind_many_versioned(bindings, v),
+                )
+                metrics.register_federation_conflict("won" if conflicts else "clean")
+                for _pod, _hostname, _task, seq in entries:
+                    self._journal_confirm(seq)
+                return
+            except StaleWrite as e:
+                conflicts += 1
+                if conflicts > self._conflict_max_retries:
+                    metrics.register_federation_conflict("lost")
+                    log.errorf(
+                        "bind of %s lost the conflict after %d retr%s (%s); "
+                        "accepting store truth and resyncing the gang",
+                        what, conflicts - 1, "y" if conflicts == 2 else "ies", e,
+                    )
+                    for _pod, _hostname, task, seq in entries:
+                        self._journal_confirm(seq)
+                        self.resync_task(task)
+                    return
+                metrics.register_federation_conflict("retried")
+                metrics.register_bind_retry()
+                log.warningf(
+                    "bind of %s conflicted (%s), retry %d/%d with fresh version",
+                    what, e, conflicts, self._conflict_max_retries,
+                )
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, 0.5)
+                version = getattr(self.store, "version", version)
+            except Exception as e:  # noqa: BLE001 - infrastructure failure
+                # unchanged rung 2: the intents stay unconfirmed, the
+                # resync path (or a takeover reconciliation) re-drives
+                log.errorf("Failed to bind %s: %s", what, e)
+                for _pod, _hostname, task, _seq in entries:
+                    self.resync_task(task)
+                return
 
     def _write_with_retry(self, op: str, what: str, fn) -> None:
         """Bounded in-place retry with exponential backoff + jitter for
@@ -1040,6 +1153,11 @@ class SchedulerCache:
                     raise faults.FaultInjected(f"{op}.write")
                 fn()
                 return
+            except StaleWrite:
+                # optimistic conflict, not a transient infrastructure
+                # failure: re-sending the same snapshot version would
+                # lose again — the caller refreshes the version first
+                raise
             except Exception as e:
                 attempt += 1
                 if attempt > self._write_retries:
@@ -1083,13 +1201,37 @@ class SchedulerCache:
         self._submit_write(self._do_evict, pod, task, seqs[0])
 
     def _do_evict(self, pod: Pod, task: TaskInfo, seq=None) -> None:
+        conditional = self._conditional_binds and hasattr(
+            self.evictor, "evict_versioned"
+        )
+        version = self._snapshot_version
+        if conditional and faults.should_fire("federation.stale_assign"):
+            version = 0
         try:
-            self._write_with_retry(
-                "evict",
-                f"<{pod.namespace}/{pod.name}>",
-                lambda: self.evictor.evict(pod),
+            if conditional:
+                self._write_with_retry(
+                    "evict",
+                    f"<{pod.namespace}/{pod.name}>",
+                    lambda: self.evictor.evict_versioned(pod, version),
+                )
+            else:
+                self._write_with_retry(
+                    "evict",
+                    f"<{pod.namespace}/{pod.name}>",
+                    lambda: self.evictor.evict(pod),
+                )
+            self._journal_confirm(seq)
+        except StaleWrite as e:
+            # an evict that lost the race is moot: whatever placement won
+            # invalidated the preemption plan — accept store truth now
+            # (no blind retry loop; the next cycle re-solves)
+            metrics.register_federation_conflict("lost")
+            log.errorf(
+                "Evict of <%s/%s> lost the conflict (%s); accepting store truth",
+                pod.namespace, pod.name, e,
             )
             self._journal_confirm(seq)
+            self.resync_task(task)
         except Exception as e:  # noqa: BLE001
             log.errorf("Failed to evict pod <%s/%s>: %s", pod.namespace, pod.name, e)
             self.resync_task(task)
@@ -1163,6 +1305,9 @@ class SchedulerCache:
             reset()  # assumptions never outlive a session (see reset())
         with self._mutex:
             snapshot = ClusterInfo()
+            # Stamp the store version this snapshot solves over — every
+            # conditional dispatch until the next snapshot carries it.
+            self._snapshot_version = getattr(self.store, "version", 0)
             for name, node in self.nodes.items():
                 snapshot.nodes[name] = node.clone()
             for name, q in self.queues.items():

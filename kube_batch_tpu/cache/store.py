@@ -19,6 +19,7 @@ thread per kind.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -35,6 +36,7 @@ from kube_batch_tpu.apis.types import (
     Pod,
     PodDisruptionBudget,
     PodGroup,
+    PodPhase,
     PriorityClass,
     Queue,
     StorageClass,
@@ -63,6 +65,28 @@ _CLUSTER_SCOPED = {NODES, QUEUES, PRIORITY_CLASSES, PVS, STORAGE_CLASSES, LEASES
 class AlreadyExists(KeyError):
     """create() of a key already present — typed so API layers can map
     it to HTTP 409 without string-matching the message."""
+
+
+class StaleWrite(RuntimeError):
+    """Optimistic-concurrency rejection (Omega-style): a conditional
+    write carried a snapshot version older than the store state it would
+    overwrite, or the write no longer applies to current truth. Typed —
+    and carrying the conflicted object — so the losing scheduler can
+    resync just the conflicted gang and retry, instead of treating the
+    rejection like an infrastructure write failure."""
+
+    def __init__(
+        self, kind: str, key: str, reason: str, expected: int, actual: int
+    ) -> None:
+        super().__init__(
+            f"stale write on {kind} {key!r}: {reason} "
+            f"(snapshot v{expected}, store v{actual})"
+        )
+        self.kind = kind
+        self.key = key
+        self.reason = reason
+        self.expected = expected
+        self.actual = actual
 
 
 def obj_key(kind: str, obj: Any) -> str:
@@ -129,6 +153,19 @@ class ClusterStore:
         # and the nested event is delivered inline.
         self._dispatch_lock = threading.RLock()
         self._events: deque = deque()  # (verb, handlers, old, new)
+        # Optimistic-concurrency state (all #: guarded_by _lock):
+        # _version counts every committed mutation; a scheduler stamps
+        # it into its snapshot and sends it back with each conditional
+        # write. _placement_version[node] is the store version of the
+        # last placement write touching that node — the conflict check
+        # is per node, not global, so schedulers binding onto disjoint
+        # nodes never conflict. _node_alloc[node] is the running sum of
+        # bound, non-terminal pod requests, maintained incrementally so
+        # the conditional commit can reject an over-capacity bind in
+        # O(gang) instead of O(pods).
+        self._version = 0
+        self._placement_version: dict[str, int] = {}
+        self._node_alloc: dict[str, Any] = {}
 
     # -- event pump --------------------------------------------------------
 
@@ -161,6 +198,67 @@ class ClusterStore:
                 self._events.append(("add", [handler], None, obj))
         self._drain()
 
+    # -- optimistic-concurrency bookkeeping --------------------------------
+
+    @property
+    def version(self) -> int:
+        """Monotonic store version: bumps once per committed mutation.
+        Schedulers stamp it into their snapshot and send it back with
+        every conditional write (conditional_bind_many / _unbind)."""
+        with self._lock:
+            return self._version
+
+    def placement_version(self, node: str) -> int:
+        """Store version of the last placement write touching ``node``
+        (0 = never placed on). The per-node conflict granularity."""
+        with self._lock:
+            return self._placement_version.get(node, 0)
+
+    def node_allocated(self, node: str) -> Any:
+        """Clone of the incremental allocated-resource sum for ``node``
+        (bound, non-terminal pods). Bench/fsck introspection."""
+        from kube_batch_tpu.api.resource_info import Resource
+
+        with self._lock:
+            alloc = self._node_alloc.get(node)
+            return alloc.clone() if alloc is not None else Resource.empty()
+
+    @assume_locked
+    def _bump_locked(self) -> int:
+        self._version += 1
+        return self._version
+
+    @assume_locked
+    def _account_locked(self, kind: str, old: Any, new: Any) -> None:
+        """Maintain _node_alloc/_placement_version across one committed
+        pod mutation. Runs AFTER _bump_locked so the placement version
+        recorded is the mutation's own version. A pod contributes to its
+        node's allocation while bound and non-terminal; any transition
+        in or out of that state is a placement write on the node."""
+        if kind != PODS:
+            return
+        from kube_batch_tpu.api.helpers import get_pod_resource_request
+        from kube_batch_tpu.api.resource_info import Resource
+
+        for pod, sign in ((old, -1), (new, +1)):
+            if pod is None or not pod.node_name:
+                continue
+            if pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            node = pod.node_name
+            req = get_pod_resource_request(pod)
+            alloc = self._node_alloc.setdefault(node, Resource.empty())
+            if sign > 0:
+                alloc.add(req)
+            else:
+                # tolerant subtract (Resource.sub raises on epsilon
+                # underflow; symmetric add/remove must never throw here)
+                alloc.milli_cpu -= req.milli_cpu
+                alloc.memory -= req.memory
+                for name, q in req.scalars.items():
+                    alloc.scalars[name] = alloc.scalars.get(name, 0.0) - q
+            self._placement_version[node] = self._version
+
     # -- CRUD --------------------------------------------------------------
 
     @assume_locked
@@ -177,6 +275,8 @@ class ClusterStore:
             if key in ks.objects:
                 raise AlreadyExists(f"{kind} {key!r} already exists")
             ks.objects[key] = obj
+            self._bump_locked()
+            self._account_locked(kind, None, obj)
             self._events.append(("add", list(ks.handlers), None, obj))
         log.V(4).infof("store: created %s %s", kind, key)
         self._drain()
@@ -190,6 +290,8 @@ class ClusterStore:
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
             ks.objects[key] = obj
+            self._bump_locked()
+            self._account_locked(kind, old, obj)
             self._events.append(("update", list(ks.handlers), old, obj))
         log.V(4).infof("store: updated %s %s", kind, key)
         self._drain()
@@ -201,10 +303,169 @@ class ClusterStore:
             obj = ks.objects.pop(key, None)
             if obj is None:
                 raise KeyError(f"{kind} {key!r} not found")
+            self._bump_locked()
+            self._account_locked(kind, obj, None)
             self._events.append(("delete", list(ks.handlers), obj, None))
         log.V(4).infof("store: deleted %s %s", kind, key)
         self._drain()
         return obj
+
+    # -- conditional writes (Omega-style optimistic concurrency) -----------
+
+    def conditional_bind_many(
+        self, bindings: list[tuple[str, str, str]], snapshot_version: int
+    ) -> list[Pod]:
+        """Transactionally bind ``[(namespace, name, hostname)]`` against
+        the snapshot the scheduler solved over. Every entry is checked
+        under ONE lock hold before ANY entry is applied — all-or-nothing
+        per call, so the caller dispatches one gang per transaction and a
+        rejected gang needs no rollback. Rejection reasons (StaleWrite):
+
+        - ``missing``       the pod was deleted since the snapshot
+        - ``already_bound`` another scheduler placed the pod first
+        - ``no_node``       the target node is gone
+        - ``stale_node``    the node took a placement write the snapshot
+                            never saw (per-node version check)
+        - ``capacity``      store-side admission: requests no longer fit
+        - ``injected``      the ``store.conflict`` fault drill
+
+        A pod already bound to the SAME host is skipped, not rejected —
+        that is the idempotent journal re-dispatch case."""
+        from kube_batch_tpu import faults
+        from kube_batch_tpu.api.helpers import get_pod_resource_request
+        from kube_batch_tpu.api.resource_info import Resource
+
+        with self._lock:
+            if faults.should_fire("store.conflict"):
+                ns, name, _h = bindings[0] if bindings else ("", "", "")
+                raise StaleWrite(
+                    PODS, f"{ns}/{name}", "injected", snapshot_version, self._version
+                )
+            ks = self._ks(PODS)
+            nodes = self._ks(NODES).objects
+            staged: list[tuple[str, Pod, str]] = []
+            batch_alloc: dict[str, Resource] = {}
+            for ns, name, hostname in bindings:
+                key = f"{ns}/{name}"
+                old = ks.objects.get(key)
+                if old is None:
+                    raise StaleWrite(
+                        PODS, key, "missing", snapshot_version, self._version
+                    )
+                if old.node_name:
+                    if old.node_name == hostname:
+                        continue  # journal re-dispatch: already landed
+                    raise StaleWrite(
+                        PODS, key, "already_bound", snapshot_version, self._version
+                    )
+                node = nodes.get(hostname)
+                if node is None:
+                    raise StaleWrite(
+                        NODES, hostname, "no_node", snapshot_version, self._version
+                    )
+                node_v = self._placement_version.get(hostname, 0)
+                if node_v > snapshot_version:
+                    raise StaleWrite(
+                        NODES, hostname, "stale_node", snapshot_version, node_v
+                    )
+                req = get_pod_resource_request(old)
+                pending = batch_alloc.setdefault(hostname, Resource.empty())
+                have = self._node_alloc.get(hostname)
+                total = have.clone() if have is not None else Resource.empty()
+                total.add(pending).add(req)
+                if not total.less_equal(Resource.from_resource_list(node.allocatable)):
+                    raise StaleWrite(
+                        NODES, hostname, "capacity", snapshot_version, self._version
+                    )
+                pending.add(req)
+                staged.append((key, old, hostname))
+            applied: list[Pod] = []
+            for key, old, hostname in staged:
+                new = dataclasses.replace(old, node_name=hostname)
+                ks.objects[key] = new
+                self._bump_locked()
+                self._account_locked(PODS, old, new)
+                self._events.append(("update", list(ks.handlers), old, new))
+                applied.append(new)
+        log.V(4).infof(
+            "store: conditionally bound %d pod(s) at snapshot v%d",
+            len(applied), snapshot_version,
+        )
+        self._drain()
+        return applied
+
+    def conditional_unbind(
+        self, namespace: str, name: str, snapshot_version: int
+    ) -> Optional[Pod]:
+        """Optimistic evict twin of conditional_bind_many: clear the
+        pod's placement iff its node took no placement write since the
+        snapshot. An already-unbound pod is the idempotent re-dispatch
+        case and returns the current object unchanged."""
+        from kube_batch_tpu import faults
+
+        key = f"{namespace}/{name}"
+        with self._lock:
+            if faults.should_fire("store.conflict"):
+                raise StaleWrite(
+                    PODS, key, "injected", snapshot_version, self._version
+                )
+            ks = self._ks(PODS)
+            old = ks.objects.get(key)
+            if old is None:
+                raise StaleWrite(PODS, key, "missing", snapshot_version, self._version)
+            if not old.node_name:
+                return old  # journal re-dispatch: already unbound
+            node_v = self._placement_version.get(old.node_name, 0)
+            if node_v > snapshot_version:
+                raise StaleWrite(
+                    NODES, old.node_name, "stale_node", snapshot_version, node_v
+                )
+            new = dataclasses.replace(old, node_name="")
+            ks.objects[key] = new
+            self._bump_locked()
+            self._account_locked(PODS, old, new)
+            self._events.append(("update", list(ks.handlers), old, new))
+        log.V(4).infof(
+            "store: conditionally unbound %s at snapshot v%d", key, snapshot_version
+        )
+        self._drain()
+        return new
+
+    def conditional_evict(
+        self, namespace: str, name: str, snapshot_version: int
+    ) -> Optional[Pod]:
+        """Optimistic delete (the evictor's transaction): remove the pod
+        iff its node took no placement write since the snapshot — a
+        preemption decision solved over a stale view must not kill a pod
+        another scheduler just placed around. A pod already gone is the
+        idempotent re-dispatch case."""
+        from kube_batch_tpu import faults
+
+        key = f"{namespace}/{name}"
+        with self._lock:
+            if faults.should_fire("store.conflict"):
+                raise StaleWrite(
+                    PODS, key, "injected", snapshot_version, self._version
+                )
+            ks = self._ks(PODS)
+            old = ks.objects.get(key)
+            if old is None:
+                return None  # journal re-dispatch: already evicted
+            if old.node_name:
+                node_v = self._placement_version.get(old.node_name, 0)
+                if node_v > snapshot_version:
+                    raise StaleWrite(
+                        NODES, old.node_name, "stale_node", snapshot_version, node_v
+                    )
+            ks.objects.pop(key)
+            self._bump_locked()
+            self._account_locked(PODS, old, None)
+            self._events.append(("delete", list(ks.handlers), old, None))
+        log.V(4).infof(
+            "store: conditionally evicted %s at snapshot v%d", key, snapshot_version
+        )
+        self._drain()
+        return old
 
     def get(self, kind: str, key: str) -> Optional[Any]:
         with self._lock:
@@ -280,6 +541,7 @@ class ClusterStore:
                 ),
             )
             ks.objects[name] = new
+            self._bump_locked()
             if cur is None:
                 self._events.append(("add", list(ks.handlers), None, new))
             else:
@@ -312,6 +574,7 @@ class ClusterStore:
                 lease_transitions=cur.lease_transitions,
             )
             ks.objects[name] = new
+            self._bump_locked()
             self._events.append(("update", list(ks.handlers), cur, new))
         log.infof("lease %s released by %s", name, identity)
         self._drain()

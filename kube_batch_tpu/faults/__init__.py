@@ -96,6 +96,10 @@ POINTS = (
     "encode.cache",     # cache poisoned -> state dropped, encode runs cold
     # streaming micro-cycles (scheduler.py run_micro)
     "stream.micro_cycle",  # micro-cycle solve fails -> degrade to full cycle, no pod dropped
+    # sharded federation (cache/store.py, cache/backend.py, federation.py)
+    "store.conflict",      # conditional write rejected -> loser resyncs gang + retries
+    "federation.partition",  # loopback backend transport drops -> backoff + relist heal
+    "federation.stale_assign",  # dispatch carries a stale snapshot version on purpose
     # native extension boundary (ops/, the bulk replay)
     "native.load",      # extension unavailable for the cycle -> Python twins
     "native.prepass",   # bulk_assign prepass raises -> Python replay

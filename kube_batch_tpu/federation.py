@@ -1,0 +1,428 @@
+"""Sharded multi-scheduler federation: Omega-style shared state.
+
+PR 10 tentpole (ISSUE.md). Instead of one scheduler process owning the
+whole cluster, N schedulers run concurrently against ONE store, each
+responsible for a shard of the pending workload (partitioned by queue,
+namespace, or gang — ``KBT_SHARD_KEY``). There is no pessimistic
+partitioning of *nodes*: every scheduler sees full cluster state and
+solves over all capacity, and correctness comes from optimistic
+concurrency at dispatch time (Omega, Schwarzkopf et al., EuroSys'13):
+
+- every ``bind_many``/evict transaction carries the store version the
+  scheduler's snapshot was taken at (``SchedulerCache.snapshot()``
+  stamps it);
+- the store commits a gang all-or-nothing and rejects the transaction
+  with a typed ``StaleWrite`` when any target node took a placement
+  write the snapshot never saw, the pod was already placed, or
+  store-side admission says the requests no longer fit
+  (``ClusterStore.conditional_bind_many``);
+- the loser refreshes its version and retries with jittered backoff up
+  to ``KBT_CONFLICT_MAX_RETRIES`` times; a terminal loser accepts store
+  truth — its journal intent is confirmed (store truth IS the outcome)
+  and the gang resyncs through the ordinary errTasks machinery
+  (``SchedulerCache._do_bind_gang``).
+
+Shards are about *work division*, not safety: two schedulers
+accidentally configured with the same shard stay correct (every
+double-place loses its conflict), they just waste solves. Gangs never
+split across shards — all three shard keys are gang-stable (a gang's
+pods share a podgroup, hence a queue and a namespace).
+
+Deployment shapes:
+
+- in-process (bench, interleave explorer): N ``FederatedCache`` over
+  one ``InProcessBackend``;
+- networked (docker-compose topology in deployment/): N scheduler
+  processes, each a ``LoopbackBackend`` speaking ``/backend/v1/`` to
+  one store process (a SchedulerServer whose own loop is idled by an
+  unmatched scheduler name).
+
+Env surface: ``KBT_FEDERATION`` (shard spec ``i/N``, or any non-empty
+value to force conditional dispatch on), ``KBT_SHARD_KEY`` (``queue`` |
+``namespace`` | ``gang``; default ``queue``),
+``KBT_CONFLICT_MAX_RETRIES`` (cache.py; default 3).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import zlib
+from typing import Callable, Optional
+
+from kube_batch_tpu import log
+from kube_batch_tpu.api.job_info import get_job_id, job_key
+from kube_batch_tpu.apis.types import PodPhase
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cache.store import NODES, POD_GROUPS, PODS
+
+__all__ = [
+    "ENV",
+    "SHARD_KEY_ENV",
+    "SHARD_KEYS",
+    "enabled",
+    "parse_shard_spec",
+    "shard_key_mode",
+    "shard_key_of",
+    "shard_index",
+    "FederatedCache",
+    "fsck",
+    "smoke",
+]
+
+ENV = "KBT_FEDERATION"
+SHARD_KEY_ENV = "KBT_SHARD_KEY"
+SHARD_KEYS = ("queue", "namespace", "gang")
+
+
+def enabled() -> bool:
+    """Process-wide federation switch; also flips SchedulerCache into
+    conditional (optimistic) dispatch by default (cache.py)."""
+    return os.environ.get(ENV, "") not in ("", "0")
+
+
+def parse_shard_spec(value: str) -> tuple[int, int]:
+    """``"i/N"`` -> (i, N); a bare ``"N"`` or truthy flag -> (0, 1)
+    (conditional dispatch on, no workload partition)."""
+    value = value.strip()
+    if "/" in value:
+        i_s, n_s = value.split("/", 1)
+        shard, shards = int(i_s), int(n_s)
+        if shards < 1 or not (0 <= shard < shards):
+            raise ValueError(f"bad shard spec {value!r}: want i/N with 0 <= i < N")
+        return shard, shards
+    return 0, 1
+
+
+def shard_key_mode() -> str:
+    mode = os.environ.get(SHARD_KEY_ENV, "queue").strip() or "queue"
+    if mode not in SHARD_KEYS:
+        log.errorf(
+            "%s=%r is not one of %s; using 'queue'", SHARD_KEY_ENV, mode, SHARD_KEYS
+        )
+        return "queue"
+    return mode
+
+
+def _gang_key(pod) -> str:
+    jid = get_job_id(pod)
+    if jid:
+        return jid
+    return job_key(pod.namespace, pod.metadata.owner_job or pod.metadata.uid)
+
+
+def shard_key_of(pod, store=None, mode: str = "queue") -> str:
+    """The stable string a pod shards on. All modes are gang-stable: a
+    gang's pods share a podgroup, hence one queue and one namespace, so
+    a gang never splits across schedulers (min_member gating would see
+    partial gangs otherwise)."""
+    if mode == "namespace":
+        return pod.namespace
+    if mode == "gang":
+        return _gang_key(pod)
+    # queue: resolve through the podgroup; a pod whose group has not
+    # arrived yet (or a shadow gang) falls back to its gang key — still
+    # gang-stable, just spread differently until the group lands.
+    jid = get_job_id(pod)
+    if store is not None and jid:
+        pg = store.get(POD_GROUPS, jid)
+        if pg is not None and pg.spec.queue:
+            return pg.spec.queue
+    return _gang_key(pod)
+
+
+def shard_index(key: str, shards: int) -> int:
+    """crc32-based bucket: stable across processes (``hash()`` is salted
+    per interpreter and would shard each process differently)."""
+    if shards <= 1:
+        return 0
+    return zlib.crc32(key.encode()) % shards
+
+
+class FederatedCache(SchedulerCache):
+    """A SchedulerCache owning one shard of the pending workload.
+
+    The pod filter narrows the base rule ("my pending pods + every
+    non-pending pod") to "my pending pods *in my shard* + every
+    non-pending pod" — full cluster capacity stays visible, only the
+    work divides. Conditional (optimistic) dispatch is forced on."""
+
+    def __init__(
+        self,
+        store,
+        shard: int = 0,
+        shards: int = 1,
+        shard_key: Optional[str] = None,
+        **kwargs,
+    ) -> None:
+        if not (0 <= shard < max(1, shards)):
+            raise ValueError(f"shard {shard} out of range for {shards} shards")
+        self.shard = shard
+        self.shards = max(1, shards)
+        self.shard_key = shard_key or shard_key_mode()
+        if self.shard_key not in SHARD_KEYS:
+            raise ValueError(f"shard_key must be one of {SHARD_KEYS}")
+        kwargs["conditional_binds"] = True
+        super().__init__(store, **kwargs)
+
+    def _pod_filter(self, pod) -> bool:
+        # Only UNBOUND pending pods shard: a bound pod — even one still
+        # phase-Pending, and even another shard's — holds node capacity
+        # this scheduler must account for, or its snapshots would
+        # over-place and every dispatch under contention would lose its
+        # store-side admission check forever (conflict livelock).
+        if pod.phase == PodPhase.PENDING and not pod.node_name:
+            return (
+                pod.scheduler_name == self.scheduler_name
+                and shard_index(
+                    shard_key_of(pod, self.store, self.shard_key), self.shards
+                )
+                == self.shard
+            )
+        return True  # bound/terminal pods hold capacity for everyone
+
+
+# -- fsck --------------------------------------------------------------------
+
+
+def fsck(store, epsilon: float = 1e-6) -> list[str]:
+    """Cross-scheduler consistency check over store truth; returns
+    violations (empty = clean). Invariants:
+
+    - every bound, non-terminal pod names an existing node;
+    - per node, the sum of bound non-terminal requests fits allocatable;
+    - the store's incremental allocation ledger (``node_allocated``)
+      agrees with that recomputed sum — a drifted ledger means a
+      conditional admission decision was made against wrong state."""
+    from kube_batch_tpu.api.helpers import get_pod_resource_request
+    from kube_batch_tpu.api.resource_info import Resource
+
+    out: list[str] = []
+    nodes = {n.name: n for n in store.list(NODES)}
+    per_node: dict[str, Resource] = {}
+    for pod in store.list(PODS):
+        if not pod.node_name or pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            continue
+        if pod.node_name not in nodes:
+            out.append(
+                f"pod {pod.namespace}/{pod.name} bound to missing node "
+                f"{pod.node_name!r}"
+            )
+            continue
+        per_node.setdefault(pod.node_name, Resource.empty()).add(
+            get_pod_resource_request(pod)
+        )
+    for name, used in per_node.items():
+        cap = Resource.from_resource_list(nodes[name].allocatable)
+        if not used.less_equal(cap):
+            out.append(f"node {name} over capacity: used {used} > allocatable {cap}")
+    ledger = getattr(store, "node_allocated", None)
+    if ledger is not None:
+        for name in nodes:
+            have = ledger(name)
+            want = per_node.get(name, Resource.empty())
+            if abs(have.milli_cpu - want.milli_cpu) > epsilon or abs(
+                have.memory - want.memory
+            ) > epsilon:
+                out.append(
+                    f"node {name} allocation ledger drift: ledger {have} vs "
+                    f"recomputed {want}"
+                )
+    return out
+
+
+# -- smoke -------------------------------------------------------------------
+
+
+def _seed_world(store, gangs: int, members: int, nodes: int) -> None:
+    from kube_batch_tpu.testing import (
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+
+    if store.get("queues", "default") is None:
+        store.create_queue(build_queue("default"))
+    for i in range(nodes):
+        store.create_node(
+            build_node(f"n{i}", build_resource_list(cpu=8, memory="8Gi", pods=32))
+        )
+    for g in range(gangs):
+        name = f"fg{g}"
+        store.create_pod_group(build_pod_group(name, min_member=members))
+        for m in range(members):
+            store.create_pod(
+                build_pod(
+                    name=f"{name}-p{m}",
+                    group_name=name,
+                    req=build_resource_list(cpu=1, memory="512Mi"),
+                )
+            )
+
+
+def _wait_all_bound(store, total: int, deadline_s: float) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        pods = store.list(PODS)
+        if len(pods) >= total and all(p.node_name for p in pods):
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> dict:
+    """End-to-end federation proof, runnable standalone
+    (``python -m kube_batch_tpu.federation``) and from hack/verify.py:
+
+    1. start a real SchedulerServer on loopback whose own loop is idled
+       (unmatched scheduler name) — it is the store process;
+    2. run ``shards`` FederatedCache+Scheduler pairs against it, each
+       over its own LoopbackBackend (the full wire path: list+watch,
+       conditional binds, 409 conflicts);
+    3. assert every pod bound exactly once (a store-side handler counts
+       ""->node transitions per pod), the union placement is
+       capacity-valid (fsck clean), and the *set* of bound pods matches
+       a single-scheduler twin on an identical world (which pods bind
+       is deterministic; which node wins a race is not).
+    """
+    import threading
+
+    from kube_batch_tpu.cache import EventHandler, LoopbackBackend
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.server import SchedulerServer
+
+    total = gangs * members
+    server = SchedulerServer(
+        scheduler_name="store-arbiter", listen_address="127.0.0.1:0",
+        schedule_period=60.0,
+    )
+    server.start()
+    bind_counts: dict[str, int] = {}
+    counts_lock = threading.Lock()
+
+    def _count_bind(old, new) -> None:
+        if not old.node_name and new.node_name:
+            with counts_lock:
+                key = f"{new.namespace}/{new.name}"
+                bind_counts[key] = bind_counts.get(key, 0) + 1
+
+    server.store.add_event_handler(PODS, EventHandler(on_update=_count_bind))
+    backends: list[LoopbackBackend] = []
+    scheds: list[tuple[Scheduler, threading.Thread]] = []
+    stop = threading.Event()
+    try:
+        _seed_world(server.store, gangs, members, nodes)
+        base = f"http://127.0.0.1:{server.listen_port}"
+        for i in range(shards):
+            backend = LoopbackBackend(base)
+            cache = FederatedCache(
+                backend, shard=i, shards=shards, shard_key="gang",
+                staleness_fn=backend.snapshot_age,
+            )
+            cache.run()
+            backend.start(period=0.02)
+            backends.append(backend)
+            sched = Scheduler(cache, schedule_period=0.05)
+            t = threading.Thread(
+                target=sched.run, args=(stop,), name=f"kb-fed-{i}", daemon=True
+            )
+            t.start()
+            scheds.append((sched, t))
+        all_bound = _wait_all_bound(server.store, total, deadline_s=60.0)
+    finally:
+        stop.set()
+        for _, t in scheds:
+            t.join(timeout=10.0)
+        for backend in backends:
+            backend.stop()
+        for sched, _ in scheds:
+            sched.cache.stop()
+        server.stop()
+
+    violations = fsck(server.store)
+    counts = dict(bind_counts)
+    exactly_once = all_bound and sorted(counts.values()) == [1] * total
+
+    # single-scheduler twin: same world, one cache, in-process
+    from kube_batch_tpu.cache import ClusterStore
+
+    twin = ClusterStore()
+    _seed_world(twin, gangs, members, nodes)
+    twin_cache = SchedulerCache(twin)
+    twin_cache.run()
+    twin_sched = Scheduler(twin_cache, schedule_period=0.02)
+    twin_stop = threading.Event()
+    t = threading.Thread(target=twin_sched.run, args=(twin_stop,), daemon=True)
+    t.start()
+    try:
+        _wait_all_bound(twin, total, deadline_s=30.0)
+    finally:
+        twin_stop.set()
+        t.join(timeout=10.0)
+        twin_cache.stop()
+    fed_bound = {
+        f"{p.namespace}/{p.name}"
+        for p in server.store.list(PODS)
+        if p.node_name
+    }
+    twin_bound = {
+        f"{p.namespace}/{p.name}" for p in twin.list(PODS) if p.node_name
+    }
+
+    out = {
+        "shards": shards,
+        "pods": total,
+        "bound": len(fed_bound),
+        "exactly_once": exactly_once,
+        "double_binds": sum(1 for v in counts.values() if v > 1),
+        "fsck_violations": violations,
+        "union_parity": fed_bound == twin_bound,
+    }
+    out["ok"] = bool(
+        all_bound
+        and exactly_once
+        and not violations
+        and out["union_parity"]
+        and out["bound"] == total
+    )
+    return out
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="federation smoke: N schedulers over one loopback store, "
+        "optimistic conflicts, exactly-once binds"
+    )
+    parser.add_argument("--shards", type=int, default=2)
+    parser.add_argument("--gangs", type=int, default=6)
+    parser.add_argument("--members", type=int, default=3)
+    parser.add_argument(
+        "--json", action="store_true", help="print the result dict as JSON"
+    )
+    args = parser.parse_args(argv)
+    result = smoke(shards=args.shards, gangs=args.gangs, members=args.members)
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        status = "ok" if result["ok"] else "FAILED"
+        print(
+            f"federation smoke: {status} ({result['bound']}/{result['pods']} pods "
+            f"bound across {result['shards']} schedulers, exactly_once="
+            f"{result['exactly_once']}, union_parity={result['union_parity']}, "
+            f"fsck={'clean' if not result['fsck_violations'] else result['fsck_violations']})"
+        )
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    # re-enter through the canonical module: `python -m` executes this
+    # file as __main__, whose module-level state would otherwise be
+    # distinct from the one other modules import
+    from kube_batch_tpu.federation import main as _canonical_main
+
+    raise SystemExit(_canonical_main())

@@ -298,6 +298,23 @@ streaming_backlog = Gauge(
     "Pods arrived but not yet bound that streaming mode is tracking",
 )
 
+# -- sharded federation (kube_batch_tpu.federation, cache conditional writes) -
+federation_conflicts = Counter(
+    f"{_SUBSYSTEM}_federation_conflicts_total",
+    "Optimistic-concurrency dispatch outcomes, by outcome "
+    "(clean/won/retried/lost)",
+)
+bind_retries = Counter(
+    f"{_SUBSYSTEM}_bind_retries_total",
+    "Gang bind transactions re-sent with a refreshed snapshot version "
+    "after a store conflict",
+)
+store_backend_rtt = Histogram(
+    f"{_SUBSYSTEM}_store_backend_rtt_seconds",
+    "Store-backend round-trip latency per request in seconds, by op",
+    FINE_BUCKETS,
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -418,6 +435,18 @@ def set_streaming_backlog(n: int) -> None:
     streaming_backlog.set(n)
 
 
+def register_federation_conflict(outcome: str) -> None:
+    federation_conflicts.inc({"outcome": outcome})
+
+
+def register_bind_retry() -> None:
+    bind_retries.inc()
+
+
+def observe_store_backend_rtt(op: str, seconds: float) -> None:
+    store_backend_rtt.observe(seconds, {"op": op})
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -484,6 +513,9 @@ def render_prometheus_text() -> str:
         time_to_bind,
         micro_cycles,
         streaming_backlog,
+        federation_conflicts,
+        bind_retries,
+        store_backend_rtt,
     ]
     lines: list[str] = []
     for metric in families:

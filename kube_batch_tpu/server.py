@@ -62,7 +62,7 @@ from typing import Optional
 from kube_batch_tpu import faults, log, metrics, version
 from kube_batch_tpu.apis.types import ObjectMeta, Queue, QueueSpec
 from kube_batch_tpu.cache import ClusterStore, SchedulerCache
-from kube_batch_tpu.cache.store import KINDS, AlreadyExists, EventHandler
+from kube_batch_tpu.cache.store import KINDS, AlreadyExists, EventHandler, StaleWrite
 from kube_batch_tpu.scheduler import Scheduler
 
 DEFAULT_SCHEDULER_NAME = "kube-batch-tpu"
@@ -186,7 +186,11 @@ class WatchHub:
                 if not self._active:
                     self._seq += 1
                     return
-        body = SERIALIZERS[kind](obj)
+        # The ring holds the object itself; serialization happens at poll
+        # time per consumer (observability summary vs the full-fidelity
+        # wire codec for store backends). Store objects are replaced, not
+        # mutated (the mutation detector enforces it), so a late poll
+        # serializes exactly the state the event captured.
         with self._cond:
             self._seq += 1
             ring = self._events[kind]
@@ -195,7 +199,7 @@ class WatchHub:
                 # watcher holding an rv at or before it into a re-list
                 seq, _, _ = ring.popleft()
                 self._dropped[kind] = seq
-            ring.append((self._seq, verb, body))
+            ring.append((self._seq, verb, obj))
             self._cond.notify_all()
 
     def close(self) -> None:
@@ -216,15 +220,26 @@ class WatchHub:
             return self._seq
 
     def poll(
-        self, kind: str, since: int, timeout: float, stop: threading.Event
+        self,
+        kind: str,
+        since: int,
+        timeout: float,
+        stop: threading.Event,
+        wire: bool = False,
     ) -> tuple[str, list[dict], int]:
         """("ok" | "gone", events, resourceVersion). Blocks up to
-        `timeout` seconds for the first event past `since`."""
+        `timeout` seconds for the first event past `since`. ``wire``
+        selects the full-fidelity codec (apis/wire.py, store backends)
+        over the observability summary serializer."""
         if faults.should_fire("watch.drop"):
             # Injected stream drop: the 410-Gone contract — the client
             # must re-list and resume from the returned resourceVersion.
             with self._cond:
                 return "gone", [], self._seq
+        if wire:
+            from kube_batch_tpu.apis.wire import to_wire as ser
+        else:
+            ser = SERIALIZERS[kind]
         deadline = time.monotonic() + timeout
         while True:
             with self._cond:
@@ -234,10 +249,10 @@ class WatchHub:
                 # Ring entries are seq-ascending: walk from the right only
                 # as far as `since` — O(new events), not O(ring).
                 batch: list[dict] = []
-                for seq, verb, body in reversed(self._events[kind]):
+                for seq, verb, obj in reversed(self._events[kind]):
                     if seq <= since:
                         break
-                    batch.append({"seq": seq, "type": verb, "object": body})
+                    batch.append({"seq": seq, "type": verb, "object": ser(obj)})
                 if batch:
                     batch.reverse()
                     return "ok", batch, self._seq
@@ -529,6 +544,61 @@ def _make_handler(server: "SchedulerServer"):
                 self._reply(200, "ok", "text/plain")
             elif path == "/version":
                 self._reply(200, "\n".join(version.info()) + "\n", "text/plain")
+            elif path == "/backend/v1/version":
+                # Store-backend protocol (cache/backend.py): the store
+                # version optimistic writes are checked against.
+                self._reply(200, json.dumps({"storeVersion": server.store.version}))
+            elif path.startswith("/backend/v1/watch/"):
+                kind = path[len("/backend/v1/watch/"):]
+                if kind not in SERIALIZERS:
+                    self._reply(404, json.dumps({"error": f"unknown kind {kind!r}"}))
+                    return
+                query = urllib.parse.parse_qs(parsed.query)
+                try:
+                    since = int(query.get("since", ["0"])[0])
+                    timeout = float(query.get("timeout", ["30"])[0])
+                except ValueError:
+                    self._reply(400, json.dumps({"error": "bad since/timeout"}))
+                    return
+                import math
+
+                if not math.isfinite(timeout):
+                    self._reply(400, json.dumps({"error": "bad since/timeout"}))
+                    return
+                timeout = min(max(timeout, 0.0), 300.0)
+                status, events, rv = server.watch_hub.poll(
+                    kind, since, timeout, server._stop, wire=True
+                )
+                if status == "gone":
+                    self._reply(
+                        410, json.dumps({"error": "too old", "resourceVersion": rv})
+                    )
+                    return
+                self._reply(
+                    200, json.dumps({"events": events, "resourceVersion": rv})
+                )
+            elif path.startswith("/backend/v1/"):
+                from kube_batch_tpu.apis.wire import to_wire
+
+                kind = path[len("/backend/v1/"):]
+                if kind not in SERIALIZERS:
+                    self._reply(404, json.dumps({"error": "not found"}))
+                    return
+                # rv BEFORE the list, same at-least-once rule as the
+                # observability list endpoint below.
+                rv = server.watch_hub.resource_version
+                store_v = server.store.version
+                items = [to_wire(obj) for obj in server.store.list(kind)]
+                self._reply(
+                    200,
+                    json.dumps(
+                        {
+                            "items": items,
+                            "resourceVersion": rv,
+                            "storeVersion": store_v,
+                        }
+                    ),
+                )
             elif path.startswith("/apis/v1alpha1/watch/"):
                 kind = path[len("/apis/v1alpha1/watch/"):]
                 if kind not in SERIALIZERS:
@@ -581,6 +651,95 @@ def _make_handler(server: "SchedulerServer"):
             length = int(self.headers.get("Content-Length", "0"))
             return json.loads(self.rfile.read(length) or b"{}")
 
+        def _backend_post(self, tail: str, body: dict) -> None:
+            """Store-backend mutation surface (cache/backend.py client).
+
+            Conditional writes carry the caller's snapshot version and a
+            stale one is a 409 with the full StaleWrite payload — the
+            client re-raises it so the dispatch path is backend-agnostic.
+            The generic CRUD route takes full-fidelity wire objects
+            (apis/wire.py), unlike the lossy ingestion routes below.
+            """
+            from kube_batch_tpu.apis import wire
+
+            try:
+                if tail == "bind":
+                    raw = body.get("bindings")
+                    if not isinstance(raw, list):
+                        raise ValueError("bindings must be a list")
+                    bindings = []
+                    for entry in raw:
+                        if not (isinstance(entry, (list, tuple)) and len(entry) == 3):
+                            raise ValueError(
+                                "each binding must be [namespace, name, hostname]"
+                            )
+                        bindings.append(tuple(str(x) for x in entry))
+                    version = int(body.get("snapshotVersion", 0))
+                    applied = server.store.conditional_bind_many(bindings, version)
+                    self._reply(
+                        200,
+                        json.dumps(
+                            {
+                                "applied": len(applied),
+                                "storeVersion": server.store.version,
+                            }
+                        ),
+                    )
+                elif tail == "evict":
+                    namespace = str(body.get("namespace", ""))
+                    name = str(body.get("name", ""))
+                    if not name:
+                        raise ValueError("name must be non-empty")
+                    version = int(body.get("snapshotVersion", 0))
+                    old = server.store.conditional_evict(namespace, name, version)
+                    self._reply(
+                        200,
+                        json.dumps(
+                            {
+                                "evicted": old is not None,
+                                "storeVersion": server.store.version,
+                            }
+                        ),
+                    )
+                elif tail in SERIALIZERS:
+                    verb = body.get("verb")
+                    if verb == "create":
+                        obj = wire.decode_kind(tail, body.get("object") or {})
+                        server.store.create(tail, obj)
+                    elif verb == "update":
+                        obj = wire.decode_kind(tail, body.get("object") or {})
+                        server.store.update(tail, obj)
+                    elif verb == "delete":
+                        key = body.get("key")
+                        if not isinstance(key, str) or not key:
+                            raise ValueError("delete needs a non-empty string key")
+                        server.store.delete(tail, key)
+                    else:
+                        raise ValueError(f"unknown verb {verb!r}")
+                    self._reply(
+                        200, json.dumps({"storeVersion": server.store.version})
+                    )
+                else:
+                    self._reply(404, json.dumps({"error": "not found"}))
+            except StaleWrite as e:
+                # Optimistic-concurrency loss: typed 409 so the backend
+                # client can reconstruct the exact conflict and the loser
+                # resyncs only the conflicted gang.
+                self._reply(
+                    409,
+                    json.dumps(
+                        {
+                            "conflict": {
+                                "kind": e.kind,
+                                "key": e.key,
+                                "reason": e.reason,
+                                "expected": e.expected,
+                                "actual": e.actual,
+                            }
+                        }
+                    ),
+                )
+
         def do_POST(self):  # noqa: N802
             from kube_batch_tpu.apis.types import PodPhase
             from kube_batch_tpu.testing import (
@@ -629,7 +788,9 @@ def _make_handler(server: "SchedulerServer"):
                 body = self._read_body()
                 if not isinstance(body, dict):
                     raise ValueError("request body must be a JSON object")
-                if self.path == "/apis/v1alpha1/queues":
+                if self.path.startswith("/backend/v1/"):
+                    self._backend_post(self.path[len("/backend/v1/"):], body)
+                elif self.path == "/apis/v1alpha1/queues":
                     name = field(body, "name", str, None, required=True)
                     weight = field(body, "weight", int, 1)
                     if weight < 1:
@@ -916,10 +1077,23 @@ class SchedulerServer:
         listen_address: str = DEFAULT_LISTEN_ADDRESS,
         store: Optional[ClusterStore] = None,
         journal_path: Optional[str] = None,
+        store_backend_url: Optional[str] = None,
     ) -> None:
         import os
 
-        self.store = store or ClusterStore()
+        # Federation mode (--store-backend): this process schedules over
+        # a remote store's /backend/v1/ protocol instead of owning an
+        # in-process store. The LoopbackBackend mirror duck-types the
+        # store surface, so the watch hub, the observability reads and
+        # the workload API below all serve (and proxy) from it.
+        self.backend = None
+        if store_backend_url:
+            from kube_batch_tpu.cache.backend import LoopbackBackend
+
+            self.backend = LoopbackBackend(store_backend_url)
+            self.store = self.backend
+        else:
+            self.store = store or ClusterStore()
         self.watch_hub = WatchHub(self.store)
         # Crash-consistent write side (recovery/): --journal / KBT_JOURNAL
         # attaches a bind-intent WAL to the cache; start() reconciles it
@@ -930,10 +1104,28 @@ class SchedulerServer:
             from kube_batch_tpu.recovery import WriteIntentJournal
 
             self.journal = WriteIntentJournal(journal_path)
-        self.cache = SchedulerCache(
-            self.store, scheduler_name=scheduler_name, default_queue=default_queue,
-            journal=self.journal,
-        )
+        if self.backend is not None:
+            from kube_batch_tpu.federation import (
+                ENV as FED_ENV,
+                FederatedCache,
+                parse_shard_spec,
+                shard_key_mode,
+            )
+
+            shard, shards = parse_shard_spec(
+                os.environ.get(FED_ENV, "").strip() or "1"
+            )
+            self.cache = FederatedCache(
+                self.backend, shard=shard, shards=shards,
+                shard_key=shard_key_mode(), scheduler_name=scheduler_name,
+                default_queue=default_queue, journal=self.journal,
+                staleness_fn=self.backend.snapshot_age,
+            )
+        else:
+            self.cache = SchedulerCache(
+                self.store, scheduler_name=scheduler_name,
+                default_queue=default_queue, journal=self.journal,
+            )
         self.scheduler = Scheduler(
             self.cache, scheduler_conf=scheduler_conf, schedule_period=schedule_period
         )
@@ -966,12 +1158,18 @@ class SchedulerServer:
 
     def start(self) -> None:
         # Ensure the default queue exists (the reference expects an admin
-        # to create it; the in-process store bootstraps it).
-        if self.store.get("queues", self.cache.default_queue) is None:
+        # to create it; the in-process store bootstraps it — in
+        # federation mode the store process owns that bootstrap).
+        if (
+            self.backend is None
+            and self.store.get("queues", self.cache.default_queue) is None
+        ):
             self.store.create_queue(
                 Queue(metadata=ObjectMeta(name=self.cache.default_queue))
             )
         self.reconcile()
+        if self.backend is not None:
+            self.backend.start()
         self._stop.clear()
         t_http = threading.Thread(
             target=self.httpd.serve_forever, name="kb-http", daemon=True
@@ -988,6 +1186,8 @@ class SchedulerServer:
         self.watch_hub.close()
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.backend is not None:
+            self.backend.stop()
         self.cache.stop()
         for t in self._threads:
             t.join(timeout=10)
@@ -1052,6 +1252,16 @@ def build_parser() -> argparse.ArgumentParser:
         "name, server.go:117)",
     )
     p.add_argument(
+        "--store-backend",
+        default="",
+        help="base URL of a store process (e.g. http://store:8080): run "
+        "this scheduler over its /backend/v1/ protocol instead of an "
+        "in-process store — federation mode. The shard is "
+        "KBT_FEDERATION='i/N', the partition key KBT_SHARD_KEY "
+        "(queue|namespace|gang); conflicting placements resolve by "
+        "optimistic concurrency (losers retry with a fresh snapshot)",
+    )
+    p.add_argument(
         "--journal",
         default="",
         help="bind-intent journal (WAL) path for crash-consistent "
@@ -1109,6 +1319,7 @@ def run(argv: Optional[list[str]] = None) -> None:
         default_queue=opt.default_queue,
         listen_address=opt.listen_address,
         journal_path=opt.journal or None,
+        store_backend_url=opt.store_backend or None,
     )
     # start() reconciles the journal before the loop: both the restart
     # and the lease-takeover path land here only once leadership (if
