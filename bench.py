@@ -889,6 +889,22 @@ def main() -> None:
             entry["p99_s"] = round(percentile(times, 99), 4)
         for k, v in t.items():
             entry[k] = round(v, 4)
+        # Phase breakdown on every row (ISSUE 11): where the best run's
+        # wall time went — encode vs solve vs dispatch (replay + write
+        # submit) — from the action's own perf_counter bookkeeping, so
+        # the timed region runs with KBT_TRACE off and the row costs no
+        # tracing overhead. "other_s" is the untracked remainder
+        # (session plumbing, plugin callbacks).
+        if "encode_s" in t:
+            phases = {
+                "encode_s": round(t.get("encode_s", 0.0), 4),
+                "solve_s": round(t.get("solve_s", 0.0), 4),
+                "dispatch_s": round(t.get("replay_s", 0.0), 4),
+            }
+            phases["other_s"] = round(
+                max(0.0, xla_s - sum(phases.values())), 4
+            )
+            entry["phase_breakdown"] = phases
         if serial == "live" or (serial == "cached" and full_serial):
             (serial_s, s_binds, _), _, _ = timed(
                 make_cluster, "allocate", warm=False, repeats=1

@@ -41,7 +41,14 @@ Runs, in order:
 8. the streaming smoke (python -m kube_batch_tpu.streaming --json):
    event-driven micro-cycles must bind every arrival AND place it on
    the same node a pure full-cycle twin picks (parity), with at least
-   one micro-cycle actually taken.
+   one micro-cycle actually taken;
+9. the obs tracing smoke (python -m kube_batch_tpu.obs --json): a
+   seeded two-shard federated run over live loopback backends with a
+   forced stale-dispatch conflict must produce a complete span tree
+   (check_tree clean) whose conflicted gang.bind joins the arbiter's
+   store.bind spans in one trace (cross-process propagation over the
+   backend headers), fsck-clean, with the JSONL + Chrome trace pair
+   exported. ``--obs`` requests it explicitly; it runs by default.
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
 (tests/test_faults.py + tests/test_recovery.py + tests/test_federation.py
@@ -59,7 +66,7 @@ leave store truth fsck-clean.
 
 Exit 0 iff every gate is clean.
 Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
-                              [--interleave] [--json]
+                              [--obs] [--interleave] [--json]
 
 ``--json`` appends one machine-readable summary line to stdout
 (per-gate pass/fail + finding counts) so bench/CI can record the
@@ -410,6 +417,43 @@ def run_federation_gate(env: dict) -> dict:
     }
 
 
+def run_obs_gate(env: dict) -> dict:
+    """Default gate (and --obs): the tracing end-to-end self-check
+    (python -m kube_batch_tpu.obs --json). Two federated shards over
+    live loopback backends, a forced stale-dispatch conflict, and the
+    smoke's own assertions: complete span tree, the conflicted
+    gang.bind joined by the arbiter-side store.bind in one trace,
+    fsck-clean store, JSONL + Chrome trace exported."""
+    import json
+
+    env = dict(env)
+    # a tracing/federation override armed in the shell would skew the
+    # smoke (it arms KBT_TRACE and the conf itself)
+    for var in ("KBT_TRACE", "KBT_FEDERATION", "KBT_SHARD_KEY",
+                "KBT_FLIGHT_RECORDER"):
+        env.pop(var, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.obs", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    summary: dict = {}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: obs tracing smoke produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and summary.get("ok", False)
+    if not ok:
+        print(f"verify: obs tracing smoke FAILED ({summary})")
+    return {
+        "ok": ok,
+        "spans": summary.get("spans"),
+        "conflicted_gang_binds": summary.get("conflicted_gang_binds"),
+        "remote_spans_joined": summary.get("remote_spans_joined"),
+        "tree_violations": len(summary.get("tree_violations") or []),
+    }
+
+
 def run_analysis_gate(strict: bool) -> dict:
     """The domain-aware suite as a subprocess (same pattern as the fsck
     gate: the CLI is the contract). Returns a summary dict for --json."""
@@ -535,7 +579,7 @@ def main(argv: list[str] | None = None) -> int:
     unknown = [
         a for a in argv
         if a not in ("--strict", "--chaos", "--json", "--interleave",
-                     "--federation")
+                     "--federation", "--obs")
     ]
     if unknown:
         print(f"verify: unknown argument(s): {' '.join(unknown)}")
@@ -690,7 +734,14 @@ def main(argv: list[str] | None = None) -> int:
         print("verify: streaming smoke FAILED")
         failed = True
 
-    # 7c. --federation: the wire-path smoke + the seeded two-scheduler
+    # 7c. obs tracing smoke: span tree + cross-process propagation +
+    # conflicted-bind join over the real wire path (--obs requests it
+    # explicitly; it is part of the default gate set)
+    gates["obs_tracing_smoke"] = run_obs_gate(env)
+    if not gates["obs_tracing_smoke"]["ok"]:
+        failed = True
+
+    # 7d. --federation: the wire-path smoke + the seeded two-scheduler
     # conflict drill (optimistic concurrency over the extracted backend)
     if federation:
         gates["federation"] = run_federation_gate(env)

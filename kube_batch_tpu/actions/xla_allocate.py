@@ -48,7 +48,7 @@ import os
 import jax  # noqa: F401  -- fail registration, not mid-cycle, when absent
 import numpy as np
 
-from kube_batch_tpu import faults, metrics
+from kube_batch_tpu import faults, metrics, obs
 from kube_batch_tpu import log as _glog
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
@@ -220,18 +220,23 @@ class XlaAllocateAction(Action):
 
         micro = bool(getattr(ssn, "micro_cycle", False))
         t0 = _time.perf_counter()
-        enc = encode_session(
-            ssn.jobs,
-            ssn.nodes,
-            ssn.queues,
-            dtype=dtype,
-            drf=ssn.plugins.get("drf") if enable_drf else None,
-            proportion=ssn.plugins.get("proportion") if enable_proportion else None,
-            session=ssn,
-            resident_interpod=self.last_interpod_active if micro else None,
-        )
-        if not micro:
-            self.last_interpod_active = bool(enc.interpod_active)
+        with obs.span("encode", micro=micro) as espan:
+            enc = encode_session(
+                ssn.jobs,
+                ssn.nodes,
+                ssn.queues,
+                dtype=dtype,
+                drf=ssn.plugins.get("drf") if enable_drf else None,
+                proportion=ssn.plugins.get("proportion") if enable_proportion else None,
+                session=ssn,
+                resident_interpod=self.last_interpod_active if micro else None,
+            )
+            if not micro:
+                self.last_interpod_active = bool(enc.interpod_active)
+            espan.set_attr("tasks", len(enc.tasks))
+            espan.set_attr("nodes", len(ssn.nodes))
+            # cross-cycle encode-cache temperature of THIS encode
+            espan.set_attr("warm_fraction", metrics.encode_warm_fraction.value())
         if not enc.tasks:
             return
         t_encode = _time.perf_counter() - t0
@@ -292,42 +297,60 @@ class XlaAllocateAction(Action):
         )
 
         t0 = _time.perf_counter()
+        sspan = obs.span("solve", mesh=self.last_mesh_size)
+        compile0 = 0
+        if sspan is not obs.NOOP_SPAN:
+            from kube_batch_tpu.analysis.trace.sentinel import compile_count
+
+            compile0 = compile_count()
         try:
-            state = solve_fn(None)
-            while int(state.paused_at) >= 0:
-                if budget is not None:
-                    budget.check("between solve segments")
-                # Segmented hybrid: sync the session up to the pause point,
-                # serial-step the host-only task, resume the kernel.
-                s = jax.tree_util.tree_map(np.array, state)  # writable host copy
-                replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
-                s = self._host_step(ssn, enc, arrays, replay, s)
-                if enc.interpod_active:
-                    # the host-stepped pod carries pod-affinity terms; once
-                    # resident it shifts every group's InterPodAffinity score
-                    from kube_batch_tpu.ops.encode import compute_pod_sc
+            with sspan, obs.annotate("kbt.solve"):
+                state = solve_fn(None)
+                while int(state.paused_at) >= 0:
+                    if budget is not None:
+                        budget.check("between solve segments")
+                    # Segmented hybrid: sync the session up to the pause point,
+                    # serial-step the host-only task, resume the kernel.
+                    sspan.event("host_step", step=int(state.step))
+                    s = jax.tree_util.tree_map(np.array, state)  # writable host copy
+                    replay.apply_upto(s.assign_pos, s.assigned_node, s.assigned_kind, int(s.step))
+                    s = self._host_step(ssn, enc, arrays, replay, s)
+                    if enc.interpod_active:
+                        # the host-stepped pod carries pod-affinity terms; once
+                        # resident it shifts every group's InterPodAffinity score
+                        from kube_batch_tpu.ops.encode import compute_pod_sc
 
-                    arrays["pod_sc"] = compute_pod_sc(
-                        enc.task_reps,
-                        ssn.nodes,
-                        enc.node_names,
-                        np.asarray(arrays["pod_sc"]).shape[1],
-                        dtype,
-                    )
-                    if dev_arrays is not None:
-                        # mirror the refresh into the device view the
-                        # XLA rungs solve from
-                        dev_arrays["pod_sc"] = self._arena.upload(
-                            "pod_sc", arrays["pod_sc"], mesh=mesh
+                        arrays["pod_sc"] = compute_pod_sc(
+                            enc.task_reps,
+                            ssn.nodes,
+                            enc.node_names,
+                            np.asarray(arrays["pod_sc"]).shape[1],
+                            dtype,
                         )
-                state = solve_fn(s)
+                        if dev_arrays is not None:
+                            # mirror the refresh into the device view the
+                            # XLA rungs solve from
+                            dev_arrays["pod_sc"] = self._arena.upload(
+                                "pod_sc", arrays["pod_sc"], mesh=mesh
+                            )
+                    state = solve_fn(s)
 
-            result = result_of(state)
-            # all three result vectors come off-device here: the transfer is
-            # part of the solve's device round-trip, not of the replay
-            assign_pos = np.asarray(result.assign_pos)
-            assigned_node = np.asarray(result.assigned_node)
-            assigned_kind = np.asarray(result.assigned_kind)
+                result = result_of(state)
+                # all three result vectors come off-device here: the transfer is
+                # part of the solve's device round-trip, not of the replay
+                assign_pos = np.asarray(result.assign_pos)
+                assigned_node = np.asarray(result.assigned_node)
+                assigned_kind = np.asarray(result.assigned_kind)
+                sspan.set_attr("tier", self.last_solver_tier)
+                if sspan is not obs.NOOP_SPAN:
+                    from kube_batch_tpu.analysis.trace.sentinel import compile_count
+
+                    compiled = compile_count() - compile0
+                    if compiled:
+                        # a warm cycle that compiles is THE regression the
+                        # CompileSentinel exists for — make it visible on
+                        # the trace, not just in the budget assert
+                        sspan.event("compile", count=compiled)
         except _DeviceSolveError as e:
             # Bottom of the ladder: serial finishes the cycle. Any
             # already-replayed host-step segments stand — serial allocate
@@ -341,14 +364,15 @@ class XlaAllocateAction(Action):
             return
         t_solve = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
-        if budget is not None:
-            # The last pre-dispatch gate: past this point binds reach
-            # the cache and the cycle can no longer abort cleanly. The
-            # cycle.overrun drill injects here (inject=True) — maximal
-            # discardable work, zero cache mutation.
-            budget.check("dispatch barrier", inject=True)
-        replay.finish(np.asarray(result.ready_cnt))
+        with obs.span("gang.assign", assigned=int(result.n_assigned)):
+            replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
+            if budget is not None:
+                # The last pre-dispatch gate: past this point binds reach
+                # the cache and the cycle can no longer abort cleanly. The
+                # cycle.overrun drill injects here (inject=True) — maximal
+                # discardable work, zero cache mutation.
+                budget.check("dispatch barrier", inject=True)
+            replay.finish(np.asarray(result.ready_cnt))
         self.last_timings = {
             "encode_s": t_encode,
             "solve_s": t_solve,

@@ -27,6 +27,15 @@ checked only by grep and luck:
   through ``Session.bump_state()`` (R006) — a raw ``state_seq += 1``
   (or assignment) outside that one hook is a mutation the streaming
   dirty tracker and state_seq-keyed score memos cannot observe.
+- **span names**: the literal first argument of every ``obs.span(...)``
+  / ``obs.emit(...)`` call must be declared in ``obs.SPAN_NAMES``
+  (R007) — a typo'd name silently forks the trace tree — and every
+  declared name must have a call site (R008).
+- **debug endpoints**: every ``/debug/*`` route literal in server.py
+  must be declared in ``obs.DEBUG_ENDPOINTS`` and vice versa (R009 —
+  an undeclared route escapes the contract, a declared-but-unserved
+  one 404s), and every declared endpoint needs a row in the deployment
+  runbook's endpoint table, with no dead documented rows (R010).
 """
 
 from __future__ import annotations
@@ -41,10 +50,14 @@ from kube_batch_tpu.analysis import Finding, SourceFile
 
 FAULTS_MODULE = "kube_batch_tpu/faults/__init__.py"
 METRICS_MODULE = "kube_batch_tpu/metrics/__init__.py"
+OBS_MODULE = "kube_batch_tpu/obs/__init__.py"
+SERVER_MODULE = "kube_batch_tpu/server.py"
 RUNBOOK = "deployment/README.md"
 
 _ENV_RE = re.compile(r"^KBT_[A-Z0-9_]+$")
 _DOC_ENV_RE = re.compile(r"`(KBT_[A-Z0-9_]+)`")
+_DEBUG_PATH_RE = re.compile(r"^/debug/[a-z0-9_/-]+$")
+_DOC_DEBUG_RE = re.compile(r"`(/debug/[a-z0-9_/-]+)`")
 
 
 def _attr_root(node: ast.expr) -> str:
@@ -270,6 +283,170 @@ def _check_state_seq(files: list[SourceFile], findings: list[Finding]) -> None:
                 )
 
 
+# -- span names + debug endpoints (kube_batch_tpu.obs, R007-R010) ------------
+
+
+def _declared_str_tuple(
+    files: list[SourceFile], module: str, name: str
+) -> dict[str, int]:
+    """entry -> lineno of ``name = ("...", ...)`` at ``module`` top level."""
+    for sf in files:
+        if sf.path != module:
+            continue
+        mod = sf.tree
+        if not isinstance(mod, ast.Module):
+            continue
+        for node in mod.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        v = node.value
+                        if isinstance(v, (ast.Tuple, ast.List)):
+                            return {
+                                e.value: e.lineno
+                                for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            }
+    return {}
+
+
+def _check_span_names(files: list[SourceFile], findings: list[Finding]) -> None:
+    declared = _declared_str_tuple(files, OBS_MODULE, "SPAN_NAMES")
+    if not declared:
+        return
+    used: set[str] = set()
+    for sf in files:
+        if sf.path == OBS_MODULE:
+            continue  # the registry's own span/emit plumbing
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name not in ("span", "emit"):
+                continue
+            if not node.args:
+                continue
+            a = node.args[0]
+            if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+                continue  # a variable (or m.span(1)) — not checkable
+            span_name = a.value
+            if name == "span" and isinstance(fn, ast.Attribute) and _attr_root(
+                fn
+            ) not in ("obs", ""):
+                continue  # e.g. some_match.span("x") on a non-obs object
+            if span_name in declared:
+                used.add(span_name)
+            else:
+                findings.append(
+                    Finding(
+                        sf.path, node.lineno, "KBT-R007",
+                        f"span name {span_name!r} is not declared in "
+                        "obs.SPAN_NAMES — an undeclared name silently "
+                        "forks the trace tree past every tree check",
+                        symbol=f"span:{span_name}",
+                    )
+                )
+    for span_name, lineno in sorted(declared.items()):
+        if span_name not in used:
+            findings.append(
+                Finding(
+                    OBS_MODULE, lineno, "KBT-R008",
+                    f"span name {span_name!r} is declared in SPAN_NAMES but "
+                    "no obs.span()/obs.emit() call site opens it — the "
+                    "declared trace shape and the real one have diverged",
+                    symbol=f"span:{span_name}",
+                )
+            )
+
+
+def _server_debug_routes(files: list[SourceFile]) -> dict[str, int]:
+    """route -> lineno of every exact ``/debug/...`` literal in server.py."""
+    out: dict[str, int] = {}
+    for sf in files:
+        if sf.path != SERVER_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _DEBUG_PATH_RE.match(node.value)
+            ):
+                out.setdefault(node.value, node.lineno)
+    return out
+
+
+def _documented_debug(repo: str, runbook: str) -> Optional[dict[str, int]]:
+    path = os.path.join(repo, runbook)
+    if not os.path.exists(path):
+        return None
+    out: dict[str, int] = {}
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            m = _DOC_DEBUG_RE.search(line)
+            if m:
+                out.setdefault(m.group(1), lineno)
+    return out
+
+
+def _check_debug_endpoints(
+    files: list[SourceFile], repo: str, runbook: str, findings: list[Finding]
+) -> None:
+    declared = _declared_str_tuple(files, OBS_MODULE, "DEBUG_ENDPOINTS")
+    if not declared:
+        return
+    served = _server_debug_routes(files)
+    for route, lineno in sorted(served.items()):
+        if route not in declared:
+            findings.append(
+                Finding(
+                    SERVER_MODULE, lineno, "KBT-R009",
+                    f"route {route!r} is served but not declared in "
+                    "obs.DEBUG_ENDPOINTS — the debug surface contract and "
+                    "the server have diverged",
+                    symbol=f"endpoint:{route}",
+                )
+            )
+    for route, lineno in sorted(declared.items()):
+        if route not in served:
+            findings.append(
+                Finding(
+                    OBS_MODULE, lineno, "KBT-R009",
+                    f"endpoint {route!r} is declared in DEBUG_ENDPOINTS but "
+                    "server.py serves no such route — it would 404",
+                    symbol=f"endpoint:{route}",
+                )
+            )
+    documented = _documented_debug(repo, runbook)
+    if documented is None:
+        return
+    for route, lineno in sorted(declared.items()):
+        if route not in documented:
+            findings.append(
+                Finding(
+                    OBS_MODULE, lineno, "KBT-R010",
+                    f"endpoint {route!r} has no row in the deployment "
+                    f"runbook's endpoint table ({runbook})",
+                    symbol=f"endpoint:{route}",
+                )
+            )
+    for route, lineno in sorted(documented.items()):
+        if route not in declared:
+            findings.append(
+                Finding(
+                    runbook, lineno, "KBT-R010",
+                    f"endpoint {route!r} is documented but not declared in "
+                    "obs.DEBUG_ENDPOINTS — the runbook row is dead",
+                    symbol=f"endpoint:{route}",
+                )
+            )
+
+
 # -- env knobs ---------------------------------------------------------------
 
 
@@ -379,5 +556,7 @@ def analyze(
     _check_fault_points(files, findings)
     _check_metrics(files, findings)
     _check_state_seq(files, findings)
+    _check_span_names(files, findings)
+    _check_debug_endpoints(files, repo, runbook, findings)
     _check_env(files, repo, runbook, findings)
     return findings

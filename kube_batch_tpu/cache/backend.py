@@ -41,7 +41,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Optional
 
-from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu import faults, log, metrics, obs
 from kube_batch_tpu.apis import wire
 from kube_batch_tpu.cache.store import (
     KINDS,
@@ -138,10 +138,16 @@ class LoopbackBackend:
                 f"federation.partition: injected transport drop ({op})"
             )
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        # trace propagation (kube_batch_tpu.obs): the current span's ids
+        # ride as headers so the store arbiter's server-side span joins
+        # this scheduler's trace — a federated conflict's full retry
+        # story renders as ONE trace across N processes
+        headers.update(obs.current_headers())
         req = urllib.request.Request(
             f"{self.base_url}{path}",
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method=method,
         )
         start = time.perf_counter()

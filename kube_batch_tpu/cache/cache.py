@@ -36,7 +36,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu import faults, log, metrics, obs
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo, job_key, pod_key
 from kube_batch_tpu.api.node_info import NodeInfo
@@ -975,7 +975,18 @@ class SchedulerCache:
         if self.journal is None or not entries:
             return [None] * len(entries)
         try:
-            return self.journal.append_intents(op, entries, cycle=self.cycle)
+            # span link both ways: the append is a child span of the
+            # dispatching cycle, and the journal records carry the trace
+            # id so a takeover's reconciliation can name the trace that
+            # wrote each intent it re-litigates
+            cur = obs.current()
+            with obs.span("journal.append", op=op, n=len(entries)) as jspan:
+                seqs = self.journal.append_intents(
+                    op, entries, cycle=self.cycle,
+                    trace=cur.trace_id if cur is not None else "",
+                )
+                jspan.set_attr("first_seq", seqs[0] if seqs else None)
+                return seqs
         except Exception as e:  # noqa: BLE001 - disk full / injected fault
             metrics.register_journal_records("append_failed", len(entries))
             log.errorf(
@@ -990,6 +1001,7 @@ class SchedulerCache:
             return
         try:
             self.journal.confirm(seq)
+            obs.event("journal.confirm", seq=seq)
         except Exception as e:  # noqa: BLE001
             log.errorf("journal confirm of seq %s failed: %s", seq, e)
 
@@ -1024,42 +1036,47 @@ class SchedulerCache:
         jobs/tasks itself, so it is accepted for protocol compatibility
         and unused."""
         del keys
-        resolved = []
-        failed = []
-        with self._mutex:
-            for ti, hostname in pairs:
-                try:
-                    job, task = self._find_job_and_task(ti)
-                    node = self.nodes.get(hostname)
-                    if node is None:
-                        raise KeyError(f"host {hostname} missing")
-                except KeyError as e:
-                    log.errorf("Failed to bind task %s: %s", ti.uid, e)
-                    failed.append(ti)
-                    continue
-                job.update_task_status(task, TaskStatus.BINDING)
-                task.node_name = hostname
-                node.add_task(task)
-                resolved.append((task.pod, hostname, task))
-        for ti in failed:
-            self.resync_task(ti)
-        # One journal append covers the whole bulk statement (the gang
-        # ids ride per entry), flushed before the batch dispatches — a
-        # leader killed mid-batch leaves exactly the unconfirmed suffix
-        # for the standby's reconciliation.
-        seqs = self._journal_intents(
-            "bind",
-            [
-                (task.job, f"{pod.namespace}/{pod.name}", hostname)
-                for pod, hostname, task in resolved
-            ],
-        )
-        self._submit_write(
-            self._do_bind_many,
-            [(p, h, t, s) for (p, h, t), s in zip(resolved, seqs)],
-        )
+        with obs.span("dispatch", binds=len(pairs)):
+            resolved = []
+            failed = []
+            with self._mutex:
+                for ti, hostname in pairs:
+                    try:
+                        job, task = self._find_job_and_task(ti)
+                        node = self.nodes.get(hostname)
+                        if node is None:
+                            raise KeyError(f"host {hostname} missing")
+                    except KeyError as e:
+                        log.errorf("Failed to bind task %s: %s", ti.uid, e)
+                        failed.append(ti)
+                        continue
+                    job.update_task_status(task, TaskStatus.BINDING)
+                    task.node_name = hostname
+                    node.add_task(task)
+                    resolved.append((task.pod, hostname, task))
+            for ti in failed:
+                self.resync_task(ti)
+            # One journal append covers the whole bulk statement (the gang
+            # ids ride per entry), flushed before the batch dispatches — a
+            # leader killed mid-batch leaves exactly the unconfirmed suffix
+            # for the standby's reconciliation.
+            seqs = self._journal_intents(
+                "bind",
+                [
+                    (task.job, f"{pod.namespace}/{pod.name}", hostname)
+                    for pod, hostname, task in resolved
+                ],
+            )
+            # the kb-write pool thread has no ambient contextvar context:
+            # capture the current span HERE and pass it through, or the
+            # async half of the bind would start a disconnected trace
+            self._submit_write(
+                self._do_bind_many,
+                [(p, h, t, s) for (p, h, t), s in zip(resolved, seqs)],
+                obs.current(),
+            )
 
-    def _do_bind_many(self, resolved: list) -> None:
+    def _do_bind_many(self, resolved: list, ctx=None) -> None:
         if self._conditional_binds and hasattr(self.binder, "bind_many_versioned"):
             # one optimistic transaction per gang: a gang commits whole
             # or loses whole, so the conflict loser re-solves a complete
@@ -1068,12 +1085,12 @@ class SchedulerCache:
             for entry in resolved:
                 gangs.setdefault(entry[2].job, []).append(entry)
             for gang in gangs.values():
-                self._do_bind_gang(gang)
+                self._do_bind_gang(gang, ctx)
             return
         for pod, hostname, task, seq in resolved:
             self._do_bind(pod, hostname, task, seq)
 
-    def _do_bind_gang(self, entries: list) -> None:
+    def _do_bind_gang(self, entries: list, ctx=None) -> None:
         """Dispatch one gang as a conditional store transaction carrying
         the snapshot version (Omega optimistic concurrency). On
         StaleWrite the loser refreshes its version and retries with
@@ -1081,7 +1098,11 @@ class SchedulerCache:
         truth — the journal intents are confirmed (the conflict resolved
         them: the winning placement stands) and the gang's tasks resync
         from the store, re-solving next cycle. This is reconcile_journal's
-        takeover-time "store truth wins" rule applied per cycle."""
+        takeover-time "store truth wins" rule applied per cycle.
+
+        ``ctx`` is the dispatching cycle's span, captured before the
+        kb-write pool hop (bind_many) — the gang.bind span parents to it
+        so a conflict's whole retry story stays on one trace."""
         bindings = [
             (pod.namespace, pod.name, hostname)
             for pod, hostname, _task, _seq in entries
@@ -1092,46 +1113,55 @@ class SchedulerCache:
         what = f"gang <{entries[0][2].job}> ({len(entries)} pod(s))"
         delay = 0.02
         conflicts = 0
-        while True:
-            try:
-                self._write_with_retry(
-                    "bind",
-                    what,
-                    lambda v=version: self.binder.bind_many_versioned(bindings, v),
-                )
-                metrics.register_federation_conflict("won" if conflicts else "clean")
-                for _pod, _hostname, _task, seq in entries:
-                    self._journal_confirm(seq)
-                return
-            except StaleWrite as e:
-                conflicts += 1
-                if conflicts > self._conflict_max_retries:
-                    metrics.register_federation_conflict("lost")
-                    log.errorf(
-                        "bind of %s lost the conflict after %d retr%s (%s); "
-                        "accepting store truth and resyncing the gang",
-                        what, conflicts - 1, "y" if conflicts == 2 else "ies", e,
+        with obs.span(
+            "gang.bind", parent=ctx, gang=str(entries[0][2].job), pods=len(entries),
+        ) as gspan:
+            while True:
+                try:
+                    self._write_with_retry(
+                        "bind",
+                        what,
+                        lambda v=version: self.binder.bind_many_versioned(bindings, v),
                     )
-                    for _pod, _hostname, task, seq in entries:
+                    gspan.set_attr("outcome", "won" if conflicts else "clean")
+                    gspan.set_attr("conflicts", conflicts)
+                    metrics.register_federation_conflict("won" if conflicts else "clean")
+                    for _pod, _hostname, _task, seq in entries:
                         self._journal_confirm(seq)
+                    return
+                except StaleWrite as e:
+                    conflicts += 1
+                    if conflicts > self._conflict_max_retries:
+                        gspan.set_attr("outcome", "lost")
+                        gspan.set_attr("conflicts", conflicts)
+                        metrics.register_federation_conflict("lost")
+                        log.errorf(
+                            "bind of %s lost the conflict after %d retr%s (%s); "
+                            "accepting store truth and resyncing the gang",
+                            what, conflicts - 1, "y" if conflicts == 2 else "ies", e,
+                        )
+                        for _pod, _hostname, task, seq in entries:
+                            self._journal_confirm(seq)
+                            self.resync_task(task)
+                        return
+                    gspan.event("conflict", retry=conflicts, error=str(e))
+                    metrics.register_federation_conflict("retried")
+                    metrics.register_bind_retry()
+                    log.warningf(
+                        "bind of %s conflicted (%s), retry %d/%d with fresh version",
+                        what, e, conflicts, self._conflict_max_retries,
+                    )
+                    time.sleep(delay * (0.5 + random.random()))
+                    delay = min(delay * 2.0, 0.5)
+                    version = getattr(self.store, "version", version)
+                except Exception as e:  # noqa: BLE001 - infrastructure failure
+                    # unchanged rung 2: the intents stay unconfirmed, the
+                    # resync path (or a takeover reconciliation) re-drives
+                    gspan.set_attr("outcome", "error")
+                    log.errorf("Failed to bind %s: %s", what, e)
+                    for _pod, _hostname, task, _seq in entries:
                         self.resync_task(task)
                     return
-                metrics.register_federation_conflict("retried")
-                metrics.register_bind_retry()
-                log.warningf(
-                    "bind of %s conflicted (%s), retry %d/%d with fresh version",
-                    what, e, conflicts, self._conflict_max_retries,
-                )
-                time.sleep(delay * (0.5 + random.random()))
-                delay = min(delay * 2.0, 0.5)
-                version = getattr(self.store, "version", version)
-            except Exception as e:  # noqa: BLE001 - infrastructure failure
-                # unchanged rung 2: the intents stay unconfirmed, the
-                # resync path (or a takeover reconciliation) re-drives
-                log.errorf("Failed to bind %s: %s", what, e)
-                for _pod, _hostname, task, _seq in entries:
-                    self.resync_task(task)
-                return
 
     def _write_with_retry(self, op: str, what: str, fn) -> None:
         """Bounded in-place retry with exponential backoff + jitter for

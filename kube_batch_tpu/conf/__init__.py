@@ -104,6 +104,13 @@ class SchedulerConfiguration:
     env var is the equivalent process-wide switch)::
 
         streaming: true
+
+    and ``trace``: the span-tracing switch (kube_batch_tpu.obs; the
+    KBT_TRACE env var is the process-wide equivalent, and an empty
+    value defers to it). Hot-reloadable like ``faults`` — a conf push
+    flips tracing on a live scheduler on its next cycle::
+
+        trace: on
     """
 
     actions: str = ""
@@ -111,6 +118,7 @@ class SchedulerConfiguration:
     action_arguments: dict[str, dict[str, str]] = field(default_factory=dict)
     faults: str = ""
     streaming: bool = False
+    trace: str = ""
 
 
 # Default conf (reference util.go:31-42).
@@ -143,6 +151,7 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
         actions=str(data.get("actions", "")),
         faults=str(data.get("faults") or ""),
         streaming=bool(data.get("streaming", False)),
+        trace=str(data.get("trace") if data.get("trace") is not None else ""),
     )
     for action_name, args in (data.get("actionArguments") or {}).items():
         conf.action_arguments[str(action_name)] = {

@@ -219,6 +219,15 @@ class FaultRegistry:
             fired = rule.fired
         metrics.register_fault_injection(point)
         log.warningf("fault injected: %s (fire #%d)", point, fired)
+        # Snapshot the flight recorder at the moment of injection: the
+        # spans leading up to the fault are exactly what a drill wants
+        # to read post-mortem. Lazy import (obs imports faults' peers,
+        # never the reverse at module level) and throttled so a
+        # probability-armed point firing every cycle cannot turn the
+        # dump dir into a firehose.
+        from kube_batch_tpu import obs
+
+        obs.recorder.dump(reason=f"fault:{point}", min_interval_s=5.0)
         return True
 
 

@@ -48,7 +48,7 @@ from __future__ import annotations
 import os
 import time
 import zlib
-from typing import Callable, Optional
+from typing import Optional
 
 from kube_batch_tpu import log
 from kube_batch_tpu.api.job_info import get_job_id, job_key

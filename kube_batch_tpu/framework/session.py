@@ -34,7 +34,7 @@ if TYPE_CHECKING:
     # imports this module's Session for ITS annotations (same cycle)
     from kube_batch_tpu.framework.statement import Statement
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, obs
 from kube_batch_tpu.api.job_info import JobInfo, TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.queue_info import QueueInfo
@@ -410,9 +410,9 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
         job.update_task_status(task, TaskStatus.BINDING)
-        metrics.update_task_schedule_duration(
-            max(0.0, time.time() - task.pod.metadata.creation_timestamp)
-        )
+        wait = max(0.0, time.time() - task.pod.metadata.creation_timestamp)
+        metrics.update_task_schedule_duration(wait)
+        obs.slo.observe("queue_wait", job.queue, wait)
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """session.go:325-362."""
@@ -498,10 +498,13 @@ def open_session(
     ssn.action_arguments = action_arguments or {}
 
     if world is None:
-        snapshot = cache.snapshot()
-        ssn.jobs = snapshot.jobs
-        ssn.nodes = snapshot.nodes
-        ssn.queues = snapshot.queues
+        with obs.span("snapshot") as sspan:
+            snapshot = cache.snapshot()
+            ssn.jobs = snapshot.jobs
+            ssn.nodes = snapshot.nodes
+            ssn.queues = snapshot.queues
+            sspan.set_attr("jobs", len(ssn.jobs))
+            sspan.set_attr("nodes", len(ssn.nodes))
     else:
         ssn.jobs, ssn.nodes, ssn.queues = world
 
@@ -559,12 +562,13 @@ def close_session(ssn: Session, discard: bool = False) -> None:
         metrics.update_plugin_duration(plugin.name, "OnSessionClose", time.perf_counter() - start)
 
     if not discard:
-        for job in ssn.jobs.values():
-            if job.pod_group is None:
-                ssn.cache.record_job_status_event(job)
-                continue
-            job.pod_group.status = _job_status(ssn, job)
-            ssn.cache.update_job_status(job)
+        with obs.span("commit", jobs=len(ssn.jobs)):
+            for job in ssn.jobs.values():
+                if job.pod_group is None:
+                    ssn.cache.record_job_status_event(job)
+                    continue
+                job.pod_group.status = _job_status(ssn, job)
+                ssn.cache.update_job_status(job)
 
     ssn.jobs = {}
     ssn.nodes = {}

@@ -315,6 +315,22 @@ store_backend_rtt = Histogram(
     FINE_BUCKETS,
 )
 
+# -- per-queue SLO windows (kube_batch_tpu.obs SLOAccountant) ----------------
+# Sliding-window quantiles, refreshed by obs.slo.publish() at scrape
+# time — unlike the cumulative histograms above, these answer "is queue
+# Q meeting its SLO right now".
+slo_time_to_bind = Gauge(
+    f"{_SUBSYSTEM}_slo_time_to_bind_seconds",
+    "Sliding-window time-to-bind quantiles per queue "
+    "(labels: queue, quantile=p50/p90/p99)",
+)
+slo_queue_wait = Gauge(
+    f"{_SUBSYSTEM}_slo_queue_wait_seconds",
+    "Sliding-window pod-creation-to-dispatch wait quantiles per queue "
+    "(labels: queue, quantile=p50/p90/p99)",
+)
+_SLO_GAUGES = {"time_to_bind": slo_time_to_bind, "queue_wait": slo_queue_wait}
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -447,6 +463,26 @@ def observe_store_backend_rtt(op: str, seconds: float) -> None:
     store_backend_rtt.observe(seconds, {"op": op})
 
 
+def set_slo_quantile(kind: str, queue: str, quantile: str, value: float) -> None:
+    """One SLO window quantile (kind in obs.SLOAccountant.KINDS)."""
+    gauge = _SLO_GAUGES.get(kind)
+    if gauge is not None:
+        gauge.set(value, {"queue": queue, "quantile": quantile})
+
+
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double quote
+    and newline must be escaped inside the quoted value (exposition
+    format spec) — a queue named ``a"b`` or a fault reason with a
+    newline must not corrupt the scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -455,7 +491,7 @@ def _render_family(metric) -> list[str]:
         for key in label_sets:
             labels = dict(key)
             snap = metric.snapshot(labels if key else None)
-            prefix = ",".join(f'{k}="{v}"' for k, v in key)
+            prefix = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
             sep = "," if prefix else ""
             for boundary, cum in snap["buckets"].items():
                 lines.append(
@@ -474,7 +510,9 @@ def _render_family(metric) -> list[str]:
             lines.append(f"{metric.name} 0")
         for key, value in items.items():
             if key:
-                label_str = ",".join(f'{k}="{v}"' for k, v in key)
+                label_str = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in key
+                )
                 lines.append(f"{metric.name}{{{label_str}}} {value}")
             else:
                 lines.append(f"{metric.name} {value}")
@@ -516,6 +554,8 @@ def render_prometheus_text() -> str:
         federation_conflicts,
         bind_retries,
         store_backend_rtt,
+        slo_time_to_bind,
+        slo_queue_wait,
     ]
     lines: list[str] = []
     for metric in families:

@@ -1,0 +1,839 @@
+"""Cycle-level distributed tracing, flight recorder, and SLO accounting.
+
+PR 11 tentpole (ISSUE.md). The aggregate histograms in ``metrics/``
+answer "how slow"; this package answers "where did gang X's 40 ms go,
+on which shard, at which solver tier, behind which conflict retry":
+
+- **Spans.** A scheduling cycle opens a root span (``cycle`` /
+  ``micro_cycle``) with children for snapshot, encode (cache hit/warm
+  stats as attrs), solve (tier + mesh size, compile events), statement
+  commit, journal append, and store dispatch — each gang bind a span of
+  its own carrying every conflict retry as a span event. Trace context
+  crosses process boundaries as two ``/backend/v1/`` HTTP headers
+  (:data:`HDR_TRACE`/:data:`HDR_SPAN`), so a federated bind's
+  conflict-retry loop is ONE trace spanning N schedulers and the store
+  arbiter. Streaming bind echoes synthesize per-pod ``time_to_bind``
+  spans on the same tree.
+
+- **Flight recorder.** Finished spans land in a bounded in-memory ring
+  (last ``KBT_FLIGHT_RECORDER_CYCLES`` traces, default 256 ≈ 256
+  cycles) that is dumped to disk — JSON-lines plus Chrome trace-event
+  format loadable in Perfetto — on fault-point fire, cycle
+  hard-deadline abort, SIGTERM, and on demand via ``/debug/trace``.
+
+- **SLO accountant.** Sliding-window (``KBT_SLO_WINDOW_S``, default
+  300 s) p50/p90/p99 time-to-bind and queue-wait *per queue*, exposed
+  on ``/metrics`` (``kbt..._slo_*`` gauges) and ``/debug/slo`` — the
+  front-door input for ROADMAP item 1's admission lanes.
+
+Tracing is off by default and zero-allocation-cheap when off: every
+entry point checks one module bool and returns the shared no-op span
+singleton (identity-testable — see tests/test_obs.py). Arm it with
+``KBT_TRACE=1`` or the hot-reloadable conf ``trace:`` key.
+
+The registries :data:`SPAN_NAMES` and :data:`DEBUG_ENDPOINTS` are the
+single source of truth the KBT-R analyzer checks both directions
+against call sites, server routes, and the runbook (R007-R010), same
+contract as metrics/env/faults.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+
+from kube_batch_tpu import log, metrics
+
+__all__ = [
+    "ENV",
+    "RECORDER_ENV",
+    "RECORDER_CYCLES_ENV",
+    "SLO_WINDOW_ENV",
+    "HDR_TRACE",
+    "HDR_SPAN",
+    "SPAN_NAMES",
+    "DEBUG_ENDPOINTS",
+    "Span",
+    "NOOP_SPAN",
+    "enabled",
+    "configure",
+    "span",
+    "emit",
+    "event",
+    "current",
+    "current_headers",
+    "from_headers",
+    "annotate",
+    "FlightRecorder",
+    "recorder",
+    "SLOAccountant",
+    "slo",
+    "chrome_events",
+    "export_jsonl",
+    "export_chrome",
+    "install_signal_dump",
+    "smoke",
+    "main",
+]
+
+ENV = "KBT_TRACE"
+RECORDER_ENV = "KBT_FLIGHT_RECORDER"  # dump dir; "0" disables dumping
+RECORDER_CYCLES_ENV = "KBT_FLIGHT_RECORDER_CYCLES"  # ring size in traces
+SLO_WINDOW_ENV = "KBT_SLO_WINDOW_S"  # SLO sliding window, seconds
+
+HDR_TRACE = "X-KBT-Trace-Id"
+HDR_SPAN = "X-KBT-Span-Id"
+
+# Every span name any call site may open. The KBT-R analyzer checks
+# this tuple both directions (R007: literal span name used but not
+# declared here; R008: declared but no call site uses it) — a typo'd
+# span name would otherwise silently fork the trace tree.
+SPAN_NAMES = (
+    "cycle",          # scheduler.run_once root
+    "micro_cycle",    # scheduler.run_micro root (streaming)
+    "snapshot",       # session open: cache snapshot/clone
+    "encode",         # SoA encode (cache hit/warm stats as attrs)
+    "solve",          # solver entry (tier, mesh size, compile events)
+    "gang.assign",    # one solved gang's host-side assignment/replay
+    "commit",         # statement commit at session close
+    "journal.append", # write-intent journal append (seqs as attr)
+    "dispatch",       # cache.bind_many host side: resolve+journal+submit
+    "gang.bind",      # one gang's store write, conflict retries as events
+    "store.bind",     # store-arbiter side of a conditional bind (remote)
+    "time_to_bind",   # synthetic: streaming arrival -> bind echo, per pod
+)
+
+# Every /debug/* route server.py serves. Checked both directions by the
+# KBT-R analyzer (R009/R010) against server.py literals and the runbook
+# endpoint table.
+DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo")
+
+# Wall/perf anchor pair: spans are stamped with the monotonic clock (so
+# durations survive NTP steps) and exported in wall-clock microseconds
+# via this one anchor (so Perfetto timelines from N processes line up).
+_WALL0 = time.time()
+_PERF0 = time.perf_counter()
+
+
+def _now_us(perf_t: float) -> int:
+    return int((_WALL0 + (perf_t - _PERF0)) * 1e6)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+_enabled = False
+_current: contextvars.ContextVar = contextvars.ContextVar("kbt_span", default=None)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class _NoopSpan:
+    """The shared do-nothing span. Every tracing entry point returns
+    this singleton when tracing is off — no allocation, no contextvar
+    touch; tests assert ``span(...) is NOOP_SPAN`` to pin the cost."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = ""
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def set_attr(self, *a, **kw) -> None:
+        pass
+
+    def event(self, *a, **kw) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One timed, attributed node of a trace tree; a context manager
+    that makes itself the thread/task-current span for its extent."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id",
+        "start", "end", "attrs", "events", "tid", "_token",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        parent_id: str = "",
+        **attrs,
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.start = time.perf_counter()
+        self.end = 0.0
+        self.attrs = attrs
+        self.events: list[tuple[str, float, dict]] = []
+        self.tid = threading.get_ident() & 0x7FFFFFFF
+        self._token = None
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def event(self, name: str, **attrs) -> None:
+        self.events.append((name, time.perf_counter(), attrs))
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        if exc_type is not None:
+            self.attrs["error"] = f"{exc_type.__name__}: {exc}"
+        self.finish()
+        return False
+
+    def finish(self) -> None:
+        if self.end:
+            return
+        self.end = time.perf_counter()
+        recorder.add(self)
+
+    def to_dict(self) -> dict:
+        end = self.end or time.perf_counter()
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_us": _now_us(self.start),
+            "dur_us": max(1, int((end - self.start) * 1e6)),
+            "pid": os.getpid(),
+            "tid": self.tid,
+            "attrs": dict(self.attrs),
+            "events": [
+                {"name": n, "ts_us": _now_us(t), "attrs": a}
+                for n, t, a in self.events
+            ],
+        }
+
+
+def span(name: str, parent=None, **attrs):
+    """Open a span. Returns :data:`NOOP_SPAN` when tracing is off.
+
+    ``parent`` overrides the ambient current span — pass the captured
+    :func:`current` when crossing an executor boundary (contextvars do
+    NOT propagate into pool threads), or a ``(trace_id, span_id)`` pair
+    reconstructed from wire headers."""
+    if not _enabled:
+        return NOOP_SPAN
+    if parent is None:
+        parent = _current.get()
+    if isinstance(parent, Span):
+        return Span(name, parent.trace_id, parent.span_id, **attrs)
+    if isinstance(parent, tuple) and len(parent) == 2 and parent[0]:
+        return Span(name, parent[0], parent[1], **attrs)
+    return Span(name, _new_id(), "", **attrs)
+
+
+def emit(name: str, start: float, end: float, parent=None, **attrs) -> None:
+    """Record an already-elapsed interval as a finished span (e.g. a
+    streaming time-to-bind measured between two watch events).
+    ``start``/``end`` are ``time.perf_counter()`` stamps."""
+    if not _enabled:
+        return
+    s = span(name, parent=parent, **attrs)
+    if s is NOOP_SPAN:
+        return
+    s.start = start
+    s.end = end
+    recorder.add(s)
+
+
+def event(name: str, **attrs) -> None:
+    """Attach an event to the current span, if any (cheap no-op off)."""
+    if not _enabled:
+        return
+    cur = _current.get()
+    if cur is not None:
+        cur.event(name, **attrs)
+
+
+def current():
+    """The thread/task-current span, or None. Capture this before
+    handing work to a pool thread and pass it as ``parent=``."""
+    if not _enabled:
+        return None
+    return _current.get()
+
+
+def current_headers() -> dict:
+    """Wire headers propagating the current trace context, or {}."""
+    if not _enabled:
+        return {}
+    cur = _current.get()
+    if cur is None:
+        return {}
+    return {HDR_TRACE: cur.trace_id, HDR_SPAN: cur.span_id}
+
+
+def from_headers(headers) -> tuple[str, str] | None:
+    """Parse the propagation headers of an incoming request into a
+    ``parent=`` value for :func:`span`, or None when absent/off."""
+    if not _enabled:
+        return None
+    try:
+        tid = headers.get(HDR_TRACE)
+        sid = headers.get(HDR_SPAN)
+    except AttributeError:
+        return None
+    if not tid:
+        return None
+    return (str(tid), str(sid or ""))
+
+
+def annotate(label: str):
+    """A ``jax.profiler`` trace annotation for a solver entry, so
+    device profiles line up with scheduler spans; no-op when tracing is
+    off or the profiler is unavailable."""
+    if not _enabled:
+        return NOOP_SPAN
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(label)
+    except Exception:  # noqa: BLE001 - profiler is best-effort
+        return NOOP_SPAN
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of recent traces (insertion-ordered by trace id;
+    one trace ≈ one scheduling cycle). Dump snapshots under the lock
+    and writes files OUTSIDE it (KBT-D002: no blocking I/O under a
+    lock the hot span path takes)."""
+
+    def __init__(self, max_traces: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._traces: "collections.OrderedDict[str, list[dict]]" = (
+            collections.OrderedDict()
+        )
+        self.max_traces = max_traces
+        self._dumps = 0
+        self._last_dump_mono = 0.0
+        self.last_dump_path: str | None = None
+
+    def add(self, sp: Span) -> None:
+        d = sp.to_dict()
+        with self._lock:
+            bucket = self._traces.get(sp.trace_id)
+            if bucket is None:
+                self._traces[sp.trace_id] = bucket = []
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            bucket.append(d)
+
+    def resize(self, max_traces: int) -> None:
+        with self._lock:
+            self.max_traces = max(1, int(max_traces))
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [s for bucket in self._traces.values() for s in bucket]
+
+    def trace_count(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def dump_dir(self) -> str | None:
+        raw = os.environ.get(RECORDER_ENV, "")
+        if raw == "0":
+            return None
+        return raw or os.path.join(tempfile.gettempdir(), "kbt-flight")
+
+    def dump(self, reason: str = "on_demand", min_interval_s: float = 0.0) -> str | None:
+        """Write the ring to ``<dir>/flight-<pid>-<n>-<reason>.jsonl``
+        plus a sibling ``.trace.json`` (Chrome trace-event format).
+        Returns the JSONL path, or None when disabled/empty/throttled.
+        ``min_interval_s`` rate-limits dump storms (a fault point firing
+        every cycle must not turn the dump dir into a firehose)."""
+        directory = self.dump_dir()
+        if directory is None:
+            return None
+        with self._lock:
+            now = time.monotonic()
+            if min_interval_s and now - self._last_dump_mono < min_interval_s:
+                return None
+            snapshot = [s for bucket in self._traces.values() for s in bucket]
+            if not snapshot:
+                return None
+            self._last_dump_mono = now
+            self._dumps += 1
+            seq = self._dumps
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in reason)
+        base = os.path.join(directory, f"flight-{os.getpid()}-{seq}-{safe}")
+        path = base + ".jsonl"
+        try:
+            os.makedirs(directory, exist_ok=True)
+            export_jsonl(snapshot, path)
+            export_chrome(snapshot, base + ".trace.json")
+        except OSError as e:
+            log.errorf("flight recorder dump to %s failed: %s", path, e)
+            return None
+        with self._lock:
+            self.last_dump_path = path
+        log.infof("flight recorder: %d spans dumped to %s (%s)", len(snapshot), path, reason)
+        return path
+
+
+recorder = FlightRecorder()
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def export_jsonl(spans: list[dict], path: str) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        for s in spans:
+            f.write(json.dumps(s, sort_keys=True, default=str))
+            f.write("\n")
+    return path
+
+
+def chrome_events(spans: list[dict]) -> list[dict]:
+    """Chrome trace-event records (Perfetto-loadable): one complete
+    ("X") event per span, instant events for span events, and flow
+    ("s"/"f") arrows stitching parent->child edges that cross a
+    process or thread — a federated conflict then renders as one
+    connected picture across N scheduler tracks."""
+    evs: list[dict] = []
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        args = dict(s["attrs"])
+        args["trace_id"] = s["trace_id"]
+        args["span_id"] = s["span_id"]
+        if s["parent_id"]:
+            args["parent_id"] = s["parent_id"]
+        evs.append({
+            "name": s["name"], "cat": "kbt", "ph": "X",
+            "ts": s["start_us"], "dur": s["dur_us"],
+            "pid": s["pid"], "tid": s["tid"], "args": args,
+        })
+        for ev in s["events"]:
+            evs.append({
+                "name": ev["name"], "cat": "kbt", "ph": "i", "s": "t",
+                "ts": ev["ts_us"], "pid": s["pid"], "tid": s["tid"],
+                "args": dict(ev["attrs"]),
+            })
+        parent = by_id.get(s["parent_id"]) if s["parent_id"] else None
+        if parent is not None and (
+            parent["pid"] != s["pid"] or parent["tid"] != s["tid"]
+        ):
+            flow_id = int(s["span_id"][:8], 16)
+            evs.append({
+                "name": "link", "cat": "kbt.flow", "ph": "s", "id": flow_id,
+                "ts": parent["start_us"], "pid": parent["pid"],
+                "tid": parent["tid"],
+            })
+            evs.append({
+                "name": "link", "cat": "kbt.flow", "ph": "f", "bp": "e",
+                "id": flow_id, "ts": s["start_us"], "pid": s["pid"],
+                "tid": s["tid"],
+            })
+    return evs
+
+
+def export_chrome(spans: list[dict], path: str) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": chrome_events(spans)}, f, default=str)
+    return path
+
+
+# -- SLO accountant ----------------------------------------------------------
+
+
+_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+class SLOAccountant:
+    """Per-queue sliding-window latency percentiles. Two kinds:
+    ``time_to_bind`` (streaming arrival -> bind echo) and
+    ``queue_wait`` (pod creation -> dispatch). Unlike the cumulative
+    histograms in metrics/, these windows answer "is queue Q meeting
+    its SLO *right now*" — the admission-lane input (ROADMAP item 1).
+
+    Always on (a deque append is cheap and the SLO surface must not go
+    dark when tracing is off); the window length comes from
+    ``KBT_SLO_WINDOW_S`` (seconds, default 300)."""
+
+    KINDS = ("time_to_bind", "queue_wait")
+
+    def __init__(self, window_s: float | None = None) -> None:
+        if window_s is None:
+            try:
+                window_s = float(os.environ.get(SLO_WINDOW_ENV, "") or 300.0)
+            except ValueError:
+                window_s = 300.0
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        # kind -> queue -> deque[(monotonic_ts, seconds)]
+        self._windows: dict[str, dict[str, collections.deque]] = {
+            k: {} for k in self.KINDS
+        }
+
+    def _trim(self, dq: collections.deque, now: float) -> None:
+        horizon = now - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def observe(self, kind: str, queue: str, seconds: float) -> None:
+        if kind not in self._windows:
+            return
+        queue = queue or "default"
+        now = time.monotonic()
+        with self._lock:
+            dq = self._windows[kind].setdefault(queue, collections.deque())
+            dq.append((now, seconds))
+            self._trim(dq, now)
+
+    def reset(self) -> None:
+        with self._lock:
+            for per_queue in self._windows.values():
+                per_queue.clear()
+
+    def snapshot(self) -> dict:
+        """``{kind: {queue: {p50, p90, p99, n, window_s}}}`` over the
+        currently in-window observations."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        with self._lock:
+            for kind, per_queue in self._windows.items():
+                out[kind] = {}
+                for queue, dq in per_queue.items():
+                    self._trim(dq, now)
+                    values = sorted(v for _, v in dq)
+                    if not values:
+                        continue
+                    n = len(values)
+                    stats = {"n": n, "window_s": self.window_s}
+                    for label, q in _QUANTILES:
+                        idx = min(n - 1, max(0, int(q * n + 0.999999) - 1))
+                        stats[label] = values[idx]
+                    out[kind][queue] = stats
+        return out
+
+    def publish(self) -> dict:
+        """Push the current window percentiles into the /metrics gauge
+        families (kbt..._slo_*) and return the snapshot."""
+        snap = self.snapshot()
+        for kind, per_queue in snap.items():
+            for queue, stats in per_queue.items():
+                for label, _ in _QUANTILES:
+                    metrics.set_slo_quantile(kind, queue, label, stats[label])
+        return snap
+
+
+slo = SLOAccountant()
+
+
+# -- configuration -----------------------------------------------------------
+
+_OFF_WORDS = ("", "0", "false", "off", "no")
+
+
+def configure(spec=None) -> bool:
+    """(Re)resolve the tracing switch. ``spec`` is the conf ``trace:``
+    value — empty/None defers to ``KBT_TRACE``. Hot-reloadable: the
+    scheduler calls this from its conf-reload path every cycle. Also
+    re-reads the flight-recorder ring size so a conf push can deepen
+    the ring on a live process."""
+    global _enabled
+    if spec is None or str(spec).strip() == "":
+        on = os.environ.get(ENV, "").strip().lower() not in _OFF_WORDS
+    else:
+        on = str(spec).strip().lower() not in _OFF_WORDS
+    try:
+        cycles = int(os.environ.get(RECORDER_CYCLES_ENV, "") or recorder.max_traces)
+    except ValueError:
+        cycles = recorder.max_traces
+    if cycles != recorder.max_traces:
+        recorder.resize(cycles)
+    if on != _enabled:
+        log.infof("tracing %s", "enabled" if on else "disabled")
+    _enabled = on
+    return on
+
+
+def install_signal_dump() -> bool:
+    """Chain a SIGTERM handler that dumps the flight recorder before
+    the previous disposition runs. Main-thread only (signal module
+    restriction); returns False where it cannot install."""
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _dump_then_chain(signum, frame):
+            try:
+                recorder.dump(reason="sigterm")
+            except Exception:  # noqa: BLE001 - dying anyway; don't mask SIGTERM
+                pass
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _dump_then_chain)
+        return True
+    except (ValueError, OSError, RuntimeError):
+        return False
+
+
+# -- smoke -------------------------------------------------------------------
+
+
+# The vectorized pipeline, so the smoke exercises the full span tree:
+# encode/solve/gang.assign come from xla_allocate, and dispatch goes
+# through bind_many -> _do_bind_gang (the conditional per-gang
+# transaction whose conflict retries the smoke asserts on). The classic
+# `allocate` action binds per task and never takes that path. No
+# `trace:` key on purpose — every scheduler (shards AND the arbiter's
+# idle loop) defers to the KBT_TRACE env the smoke arms, so their conf
+# reloads cannot fight over the module-global switch.
+SMOKE_CONF = """
+actions: "enqueue, xla_allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def check_tree(spans: list[dict]) -> list[str]:
+    """Structural violations of a span set (empty = complete tree):
+    every non-root parent id resolves inside the same trace, every
+    span name is declared, every trace has exactly the roots it
+    claims."""
+    out: list[str] = []
+    by_trace: dict[str, dict[str, dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], {})[s["span_id"]] = s
+        if s["name"] not in SPAN_NAMES:
+            out.append(f"undeclared span name {s['name']!r}")
+    for trace_id, members in by_trace.items():
+        for s in members.values():
+            if s["parent_id"] and s["parent_id"] not in members:
+                out.append(
+                    f"span {s['name']} ({s['span_id']}) in trace {trace_id} "
+                    f"has dangling parent {s['parent_id']}"
+                )
+    return out
+
+
+def smoke(
+    shards: int = 2,
+    gangs: int = 4,
+    members: int = 3,
+    nodes: int = 6,
+    out_dir: str | None = None,
+) -> dict:
+    """Tracing end-to-end proof, runnable standalone
+    (``python -m kube_batch_tpu.obs``) and from hack/verify.py --obs:
+
+    1. arm tracing plus a one-shot ``federation.stale_assign`` fault
+       (the dispatched gang carries snapshot version 0, guaranteeing a
+       409 conflict and a winning retry);
+    2. run a seeded two-shard federated run over live LoopbackBackends
+       against a real SchedulerServer store arbiter — the full wire
+       path, headers and all;
+    3. assert the collected spans form a complete parent-child tree,
+       that a ``gang.bind`` span carries a conflict event, and that a
+       ``store.bind`` span recorded on the arbiter side joined a
+       scheduler-originated trace (cross-process propagation);
+    4. export the Chrome trace-event file + JSONL and return the paths.
+    """
+    import threading as _threading
+
+    from kube_batch_tpu import faults
+    from kube_batch_tpu.cache import LoopbackBackend
+    from kube_batch_tpu.federation import FederatedCache, _seed_world, _wait_all_bound, fsck
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.server import SchedulerServer
+
+    # Arm through the env var, not configure() directly: every
+    # scheduler cycle re-resolves the switch from conf/env (hot
+    # reload), so a bare configure("on") would be undone by the first
+    # _load_conf of a conf whose trace: key is empty.
+    prev_env = os.environ.get(ENV)
+    os.environ[ENV] = "1"
+    # a 12-pod world is far below xla_allocate's device-size floor;
+    # force the device path or the smoke would fall back to serial
+    # allocate and never take the traced encode/solve/bind_many pipeline
+    prev_floor = os.environ.get("KBT_MIN_DEVICE_PAIRS")
+    os.environ["KBT_MIN_DEVICE_PAIRS"] = "0"
+    configure()
+    recorder.clear()
+    slo.reset()
+    faults.registry.configure("federation.stale_assign:1:1")
+
+    total = gangs * members
+    server = SchedulerServer(
+        scheduler_name="obs-arbiter", listen_address="127.0.0.1:0",
+        schedule_period=60.0,
+    )
+    server.start()
+    backends: list = []
+    scheds: list = []
+    stop = _threading.Event()
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as fh:
+        fh.write(SMOKE_CONF)
+        conf_path = fh.name
+    try:
+        _seed_world(server.store, gangs, members, nodes)
+        base = f"http://127.0.0.1:{server.listen_port}"
+        for i in range(shards):
+            backend = LoopbackBackend(base)
+            cache = FederatedCache(
+                backend, shard=i, shards=shards, shard_key="gang",
+                staleness_fn=backend.snapshot_age,
+            )
+            cache.run()
+            backend.start(period=0.02)
+            backends.append(backend)
+            sched = Scheduler(
+                cache, scheduler_conf=conf_path, schedule_period=0.05
+            )
+            t = _threading.Thread(
+                target=sched.run, args=(stop,), name=f"kb-obs-{i}", daemon=True
+            )
+            t.start()
+            scheds.append((sched, t))
+        all_bound = _wait_all_bound(server.store, total, deadline_s=60.0)
+    finally:
+        stop.set()
+        for _, t in scheds:
+            t.join(timeout=10.0)
+        for backend in backends:
+            backend.stop()
+        for sched, _ in scheds:
+            sched.cache.stop()
+        server.stop()
+        faults.registry.disarm("federation.stale_assign")
+        os.unlink(conf_path)
+
+    spans = recorder.spans()
+    violations = check_tree(spans)
+    names = collections.Counter(s["name"] for s in spans)
+    conflict_binds = [
+        s for s in spans
+        if s["name"] == "gang.bind"
+        and any(ev["name"] == "conflict" for ev in s["events"])
+    ]
+    scheduler_traces = {s["trace_id"] for s in spans if s["name"] == "cycle"}
+    joined_remote = [
+        s for s in spans
+        if s["name"] == "store.bind" and s["trace_id"] in scheduler_traces
+    ]
+
+    out_dir = out_dir or os.path.join(tempfile.gettempdir(), "kbt-obs-smoke")
+    os.makedirs(out_dir, exist_ok=True)
+    jsonl_path = export_jsonl(spans, os.path.join(out_dir, "smoke.jsonl"))
+    chrome_path = export_chrome(spans, os.path.join(out_dir, "smoke.trace.json"))
+
+    if prev_env is None:
+        os.environ.pop(ENV, None)
+    else:
+        os.environ[ENV] = prev_env
+    if prev_floor is None:
+        os.environ.pop("KBT_MIN_DEVICE_PAIRS", None)
+    else:
+        os.environ["KBT_MIN_DEVICE_PAIRS"] = prev_floor
+    configure()
+    result = {
+        "shards": shards,
+        "pods": total,
+        "all_bound": all_bound,
+        "spans": len(spans),
+        "span_names": dict(sorted(names.items())),
+        "tree_violations": violations,
+        "conflicted_gang_binds": len(conflict_binds),
+        "remote_spans_joined": len(joined_remote),
+        "fsck_violations": fsck(server.store),
+        "slo": slo.snapshot(),
+        "jsonl": jsonl_path,
+        "chrome_trace": chrome_path,
+    }
+    result["ok"] = bool(
+        all_bound
+        and not violations
+        and not result["fsck_violations"]
+        and names.get("cycle", 0) > 0
+        and names.get("solve", 0) > 0
+        and names.get("gang.bind", 0) > 0
+        and conflict_binds
+        and joined_remote
+    )
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="tracing smoke: seeded two-shard federated run, span "
+        "tree checked, Chrome trace exported"
+    )
+    parser.add_argument("--shards", type=int, default=2)
+    parser.add_argument("--gangs", type=int, default=4)
+    parser.add_argument("--members", type=int, default=3)
+    parser.add_argument("--out", default=None, help="export directory")
+    parser.add_argument(
+        "--json", action="store_true", help="print the result dict as JSON"
+    )
+    args = parser.parse_args(argv)
+    result = smoke(
+        shards=args.shards, gangs=args.gangs, members=args.members,
+        out_dir=args.out,
+    )
+    if args.json:
+        print(json.dumps(result, sort_keys=True, default=str))
+    else:
+        status = "ok" if result["ok"] else "FAILED"
+        print(
+            f"obs smoke: {status} ({result['spans']} spans, "
+            f"{result['conflicted_gang_binds']} conflicted binds, "
+            f"{result['remote_spans_joined']} remote spans joined, "
+            f"tree={'complete' if not result['tree_violations'] else result['tree_violations']}, "
+            f"chrome={result['chrome_trace']})"
+        )
+    return 0 if result["ok"] else 1
+
+
+configure()

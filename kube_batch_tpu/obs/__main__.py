@@ -1,0 +1,5 @@
+"""``python -m kube_batch_tpu.obs`` — tracing smoke (see obs.main)."""
+
+from kube_batch_tpu.obs import main
+
+raise SystemExit(main())

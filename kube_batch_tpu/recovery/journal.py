@@ -103,12 +103,22 @@ class WriteIntentJournal:
     # -- write side ---------------------------------------------------------
 
     def append_intents(
-        self, op: str, entries: list[tuple[str, str, str]], cycle: int = 0
+        self,
+        op: str,
+        entries: list[tuple[str, str, str]],
+        cycle: int = 0,
+        trace: str = "",
     ) -> list[int]:
         """Append one ``intent`` record per (gang, pod_key, node) entry
         as a single flushed write; returns the assigned seqs (parallel
         to ``entries``). Raises on I/O failure or the ``journal.append``
-        fault — the caller decides whether to dispatch unprotected."""
+        fault — the caller decides whether to dispatch unprotected.
+
+        ``trace`` is the dispatching cycle's trace id (kube_batch_tpu.obs);
+        when set it rides each intent record so a takeover post-mortem
+        can join the journal against a flight-recorder dump. ``replay``
+        ignores unknown keys, so old journals and traceless writers stay
+        fully compatible."""
         if not entries:
             return []
         if faults.should_fire("journal.append"):
@@ -122,20 +132,18 @@ class WriteIntentJournal:
                     seq=seq, cycle=cycle, op=op, gang=gang, pod=pod, node=node
                 )
                 self._outstanding[seq] = intent
-                lines.append(
-                    json.dumps(
-                        {
-                            "rec": "intent",
-                            "seq": seq,
-                            "cycle": cycle,
-                            "op": op,
-                            "gang": gang,
-                            "pod": pod,
-                            "node": node,
-                        },
-                        separators=(",", ":"),
-                    )
-                )
+                rec = {
+                    "rec": "intent",
+                    "seq": seq,
+                    "cycle": cycle,
+                    "op": op,
+                    "gang": gang,
+                    "pod": pod,
+                    "node": node,
+                }
+                if trace:
+                    rec["trace"] = trace
+                lines.append(json.dumps(rec, separators=(",", ":")))
             self._write("\n".join(lines) + "\n")
         metrics.register_journal_records("intent", len(entries))
         return seqs

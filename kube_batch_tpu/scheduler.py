@@ -28,7 +28,7 @@ from typing import Optional
 
 import kube_batch_tpu.actions  # noqa: F401  (registers the action pipeline)
 import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
-from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu import faults, log, metrics, obs
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.conf import (
     load_scheduler_conf,
@@ -108,6 +108,7 @@ class Scheduler:
         # _run_streaming is live, and run_once harvests its resident
         # node table through it.
         self._conf_streaming = False
+        self._conf_trace = ""
         self._stream_state = None
         self._stream_trigger = None
         self.micro_cycles_run = 0
@@ -129,6 +130,9 @@ class Scheduler:
                 )
                 conf_str = self._conf_cache or DEFAULT_SCHEDULER_CONF
         if conf_str == self._conf_cache:
+            # env flips (KBT_TRACE) still apply between conf pushes; the
+            # conf `trace:` value, when set, wins (obs.configure)
+            obs.configure(self._conf_trace)
             return
         try:
             self.actions, self.plugins, self.action_arguments = load_scheduler_conf(
@@ -137,6 +141,8 @@ class Scheduler:
             self._conf_cache = conf_str
             parsed = parse_scheduler_conf(conf_str)
             self._conf_streaming = parsed.streaming
+            self._conf_trace = parsed.trace
+            obs.configure(parsed.trace)
             # Conf-driven fault drills (the `faults:` key, same grammar as
             # KBT_FAULTS): armed only when the conf actually changed, so a
             # drill's fire counts are not re-armed every cycle.
@@ -250,181 +256,192 @@ class Scheduler:
                 work.stale_reason,
             )
             return False
-        if faults.should_fire("stream.micro_cycle"):
-            # injected micro-solve failure: invalidate and degrade to the
-            # backstop full cycle — the backlog is untouched, no pod drops
-            st.invalidate("stream.micro_cycle fault")
-            metrics.register_micro_cycle("fault")
-            return False
-        # no _load_conf() here: conf reload (a file read + parse) stays a
-        # full-cycle affair — the backstop cycle picks up pushes within
-        # one schedule_period, and the micro hot path stays disk-free
-        detector = None
-        if mutation_detector.enabled():
-            store = getattr(self.cache, "store", None)
-            if store is not None:
-                detector = mutation_detector.MutationDetector(store)
-                detector.snapshot()
-        if hasattr(self.cache, "cycle"):
-            self.cache.cycle += 1
-        st.apply_node_patches(work.node_patches)
-        cloned, missing = self.cache.clone_jobs_for_stream(work.gangs)
-        # A gang is solvable only once enough of it exists: the podgroup
-        # add event lands before its member pods, and a mid-burst drain
-        # sees a partial gang — opening a session for either wastes a
-        # full micro-cycle (the gang gate would discard it anyway). A
-        # deferred gang stays in the backlog; its remaining pod arrivals
-        # re-wake the trigger, and the backstop full cycle catches any
-        # gang that never completes.
-        jobs = {}
-        settled = set(missing)
-        for uid, job in cloned.items():
-            pending = job.task_status_index.get(TaskStatus.PENDING)
-            if not pending:
-                settled.add(uid)  # fully placed (or empty): nothing to solve
-            elif len(job.tasks) >= job.min_available:
-                jobs[uid] = job
-        if settled:
-            trigger.prune(settled)
-        if not jobs:
-            metrics.register_micro_cycle("empty")
-            return True
-        from kube_batch_tpu.streaming import open_micro_session
+        with obs.span("micro_cycle", gangs=len(work.gangs)) as mspan:
+            if faults.should_fire("stream.micro_cycle"):
+                # injected micro-solve failure: invalidate and degrade to the
+                # backstop full cycle — the backlog is untouched, no pod drops
+                st.invalidate("stream.micro_cycle fault")
+                metrics.register_micro_cycle("fault")
+                return False
+            # no _load_conf() here: conf reload (a file read + parse) stays a
+            # full-cycle affair — the backstop cycle picks up pushes within
+            # one schedule_period, and the micro hot path stays disk-free
+            detector = None
+            if mutation_detector.enabled():
+                store = getattr(self.cache, "store", None)
+                if store is not None:
+                    detector = mutation_detector.MutationDetector(store)
+                    detector.snapshot()
+            if hasattr(self.cache, "cycle"):
+                self.cache.cycle += 1
+                mspan.set_attr("cycle", self.cache.cycle)
+            st.apply_node_patches(work.node_patches)
+            cloned, missing = self.cache.clone_jobs_for_stream(work.gangs)
+            # A gang is solvable only once enough of it exists: the podgroup
+            # add event lands before its member pods, and a mid-burst drain
+            # sees a partial gang — opening a session for either wastes a
+            # full micro-cycle (the gang gate would discard it anyway). A
+            # deferred gang stays in the backlog; its remaining pod arrivals
+            # re-wake the trigger, and the backstop full cycle catches any
+            # gang that never completes.
+            jobs = {}
+            settled = set(missing)
+            for uid, job in cloned.items():
+                pending = job.task_status_index.get(TaskStatus.PENDING)
+                if not pending:
+                    settled.add(uid)  # fully placed (or empty): nothing to solve
+                elif len(job.tasks) >= job.min_available:
+                    jobs[uid] = job
+            if settled:
+                trigger.prune(settled)
+            if not jobs:
+                metrics.register_micro_cycle("empty")
+                return True
+            from kube_batch_tpu.streaming import open_micro_session
 
-        budget = CycleBudget(self._soft_deadline, self._hard_deadline)
-        ssn = open_micro_session(
-            self.cache, self.plugins, self.action_arguments,
-            jobs, st.nodes, self.cache.clone_queues_for_stream(),
-        )
-        ssn.cycle_budget = budget
-        ssn.micro_cycle = True  # xla_allocate reads this for the
-        # resident-interpod hint; tests read it to prove the micro path ran
-        aborted: Optional[CycleDeadlineExceeded] = None
-        failed = True
-        try:
-            for action in self.actions:
-                try:
-                    action_start = time.perf_counter()
-                    action.execute(ssn)
-                    metrics.update_action_duration(
-                        action.name, time.perf_counter() - action_start
-                    )
-                    budget.check(f"after action {action.name}")
-                except CycleDeadlineExceeded as e:
-                    aborted = e
-                    break
-            failed = False
-        finally:
-            if failed or aborted is not None:
-                # the session may have mutated the resident table before
-                # dying — rebuild it from the next full snapshot
-                st.invalidate("micro-cycle aborted" if aborted else "micro-cycle failed")
-            else:
-                done = {
-                    uid
-                    for uid, job in ssn.jobs.items()
-                    if not job.task_status_index.get(TaskStatus.PENDING)
-                }
-                trigger.prune(done)
-            close_session(ssn, discard=failed or aborted is not None)
-            self.micro_cycles_run += 1
-        if aborted is not None:
-            metrics.register_micro_cycle("aborted")
-            metrics.register_cycle_overrun("hard")
-            log.errorf(
-                "micro-cycle aborted: %s (session discarded; degrading to a "
-                "full cycle)", aborted,
+            budget = CycleBudget(self._soft_deadline, self._hard_deadline)
+            ssn = open_micro_session(
+                self.cache, self.plugins, self.action_arguments,
+                jobs, st.nodes, self.cache.clone_queues_for_stream(),
             )
-            return False
-        if detector is not None:
-            detector.verify()  # raises CacheMutationError on violation
-        metrics.register_micro_cycle("ok")
-        return True
+            ssn.cycle_budget = budget
+            ssn.micro_cycle = True  # xla_allocate reads this for the
+            # resident-interpod hint; tests read it to prove the micro path ran
+            aborted: Optional[CycleDeadlineExceeded] = None
+            failed = True
+            try:
+                for action in self.actions:
+                    try:
+                        action_start = time.perf_counter()
+                        action.execute(ssn)
+                        metrics.update_action_duration(
+                            action.name, time.perf_counter() - action_start
+                        )
+                        budget.check(f"after action {action.name}")
+                    except CycleDeadlineExceeded as e:
+                        aborted = e
+                        break
+                failed = False
+            finally:
+                if failed or aborted is not None:
+                    # the session may have mutated the resident table before
+                    # dying — rebuild it from the next full snapshot
+                    st.invalidate("micro-cycle aborted" if aborted else "micro-cycle failed")
+                else:
+                    done = {
+                        uid
+                        for uid, job in ssn.jobs.items()
+                        if not job.task_status_index.get(TaskStatus.PENDING)
+                    }
+                    trigger.prune(done)
+                close_session(ssn, discard=failed or aborted is not None)
+                self.micro_cycles_run += 1
+            if aborted is not None:
+                metrics.register_micro_cycle("aborted")
+                metrics.register_cycle_overrun("hard")
+                mspan.set_attr("aborted", str(aborted))
+                obs.recorder.dump(reason="hard_deadline", min_interval_s=1.0)
+                log.errorf(
+                    "micro-cycle aborted: %s (session discarded; degrading to a "
+                    "full cycle)", aborted,
+                )
+                return False
+            if detector is not None:
+                detector.verify()  # raises CacheMutationError on violation
+            metrics.register_micro_cycle("ok")
+            return True
 
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-102)."""
         log.V(4).infof("Start scheduling ...")
         cycle_start = time.perf_counter()
-        self._load_conf()
+        self._load_conf()  # before the span: a conf push may flip tracing
 
-        # Bounded-staleness guard: scheduling over a stale mirror binds
-        # pods onto nodes that may no longer exist — refuse the cycle
-        # and let the watch client catch up (the k8s contract is the
-        # same: a scheduler partitioned from the apiserver stops).
-        if self._max_snapshot_age > 0:
-            age_fn = getattr(self.cache, "snapshot_age", None)
-            age = age_fn() if age_fn is not None else 0.0
-            if age > self._max_snapshot_age:
-                metrics.register_stale_cycle_skip()
-                log.errorf(
-                    "snapshot is %.1fs stale (threshold %.1fs); refusing to "
-                    "schedule this cycle", age, self._max_snapshot_age,
-                )
-                return
-
-        # Cycle id for the write-intent journal (recovery/journal.py):
-        # every bind/evict this cycle dispatches carries it, so a
-        # takeover can group in-flight intents by statement.
-        if hasattr(self.cache, "cycle"):
-            self.cache.cycle += 1
-
-        # Cache-mutation detector (VERDICT row 58): when enabled (tier-1
-        # runs set KBT_CACHE_MUTATION_DETECTOR), digest the store's
-        # objects before plugin+action execution and verify after — any
-        # plugin/action mutating shared cluster state in place fires.
-        detector = None
-        if mutation_detector.enabled():
-            store = getattr(self.cache, "store", None)
-            if store is not None:
-                detector = mutation_detector.MutationDetector(store)
-                detector.snapshot()
-
-        budget = CycleBudget(self._soft_deadline, self._hard_deadline)
-        ssn = open_session(self.cache, self.plugins, self.action_arguments)
-        # Actions read the budget off the session (xla_allocate threads
-        # the remaining budget into its solver entry and checks it at
-        # every pre-dispatch boundary).
-        ssn.cycle_budget = budget
-        aborted: Optional[CycleDeadlineExceeded] = None
-        try:
-            for action in self.actions:
-                try:
-                    action_start = time.perf_counter()
-                    action.execute(ssn)
-                    metrics.update_action_duration(
-                        action.name, time.perf_counter() - action_start
+        with obs.span("cycle") as cspan:
+            # Bounded-staleness guard: scheduling over a stale mirror binds
+            # pods onto nodes that may no longer exist — refuse the cycle
+            # and let the watch client catch up (the k8s contract is the
+            # same: a scheduler partitioned from the apiserver stops).
+            if self._max_snapshot_age > 0:
+                age_fn = getattr(self.cache, "snapshot_age", None)
+                age = age_fn() if age_fn is not None else 0.0
+                if age > self._max_snapshot_age:
+                    metrics.register_stale_cycle_skip()
+                    cspan.set_attr("skipped", "stale_snapshot")
+                    log.errorf(
+                        "snapshot is %.1fs stale (threshold %.1fs); refusing to "
+                        "schedule this cycle", age, self._max_snapshot_age,
                     )
-                    # post-action gate: a cycle already past its hard
-                    # budget must not start the next action
-                    budget.check(f"after action {action.name}")
-                except CycleDeadlineExceeded as e:
-                    aborted = e
-                    break
-        finally:
-            # streaming harvest: grab the session's node table BEFORE
-            # close_session rebinds it — micro-cycles solve against this
-            # resident state until the next full cycle replaces it
-            if self._stream_state is not None:
-                self._stream_state.adopt_full_cycle(ssn, aborted=aborted is not None)
-            # discard on abort: skip the status write-back so the
-            # store stays byte-identical to the cycle's start (every
-            # abort point is pre-dispatch)
-            close_session(ssn, discard=aborted is not None)
-            metrics.update_e2e_duration(time.perf_counter() - cycle_start)
-            metrics.schedule_attempts.inc()
-            log.V(4).infof("End scheduling ...")
-        if aborted is not None:
-            metrics.register_cycle_overrun("hard")
-            log.errorf(
-                "scheduling cycle aborted: %s (session discarded; pending "
-                "gangs reschedule next cycle)", aborted,
-            )
-        elif budget.soft_exceeded():
-            self._arm_tier_downgrade(budget)
-        else:
-            self._soft_overruns = 0  # a within-budget cycle clears the streak
-        if detector is not None:
-            detector.verify()  # raises CacheMutationError on violation
+                    return
+
+            # Cycle id for the write-intent journal (recovery/journal.py):
+            # every bind/evict this cycle dispatches carries it, so a
+            # takeover can group in-flight intents by statement.
+            if hasattr(self.cache, "cycle"):
+                self.cache.cycle += 1
+                cspan.set_attr("cycle", self.cache.cycle)
+
+            # Cache-mutation detector (VERDICT row 58): when enabled (tier-1
+            # runs set KBT_CACHE_MUTATION_DETECTOR), digest the store's
+            # objects before plugin+action execution and verify after — any
+            # plugin/action mutating shared cluster state in place fires.
+            detector = None
+            if mutation_detector.enabled():
+                store = getattr(self.cache, "store", None)
+                if store is not None:
+                    detector = mutation_detector.MutationDetector(store)
+                    detector.snapshot()
+
+            budget = CycleBudget(self._soft_deadline, self._hard_deadline)
+            ssn = open_session(self.cache, self.plugins, self.action_arguments)
+            # Actions read the budget off the session (xla_allocate threads
+            # the remaining budget into its solver entry and checks it at
+            # every pre-dispatch boundary).
+            ssn.cycle_budget = budget
+            aborted: Optional[CycleDeadlineExceeded] = None
+            try:
+                for action in self.actions:
+                    try:
+                        action_start = time.perf_counter()
+                        action.execute(ssn)
+                        metrics.update_action_duration(
+                            action.name, time.perf_counter() - action_start
+                        )
+                        # post-action gate: a cycle already past its hard
+                        # budget must not start the next action
+                        budget.check(f"after action {action.name}")
+                    except CycleDeadlineExceeded as e:
+                        aborted = e
+                        break
+            finally:
+                # streaming harvest: grab the session's node table BEFORE
+                # close_session rebinds it — micro-cycles solve against this
+                # resident state until the next full cycle replaces it
+                if self._stream_state is not None:
+                    self._stream_state.adopt_full_cycle(ssn, aborted=aborted is not None)
+                # discard on abort: skip the status write-back so the
+                # store stays byte-identical to the cycle's start (every
+                # abort point is pre-dispatch)
+                close_session(ssn, discard=aborted is not None)
+                metrics.update_e2e_duration(time.perf_counter() - cycle_start)
+                metrics.schedule_attempts.inc()
+                log.V(4).infof("End scheduling ...")
+            if aborted is not None:
+                metrics.register_cycle_overrun("hard")
+                cspan.set_attr("aborted", str(aborted))
+                # the interrupted cycle's spans are exactly what a
+                # post-mortem needs — dump the ring (throttled)
+                obs.recorder.dump(reason="hard_deadline", min_interval_s=1.0)
+                log.errorf(
+                    "scheduling cycle aborted: %s (session discarded; pending "
+                    "gangs reschedule next cycle)", aborted,
+                )
+            elif budget.soft_exceeded():
+                self._arm_tier_downgrade(budget)
+            else:
+                self._soft_overruns = 0  # a within-budget cycle clears the streak
+            if detector is not None:
+                detector.verify()  # raises CacheMutationError on violation
 
     def _arm_tier_downgrade(self, budget: CycleBudget) -> None:
         """Soft overrun: consecutive slow cycles trip the breaker of the

@@ -11,6 +11,10 @@ exposes:
   equivalent; serves metrics.render_prometheus_text);
 - ``GET /healthz``   — liveness;
 - ``GET /version``   — version.info();
+- ``GET /debug/trace`` — flight-recorder ring (recent cycle traces;
+  ``?dump=1`` also writes the JSONL + Chrome trace files);
+- ``GET /debug/slo`` — per-queue time-to-bind / queue-wait quantiles
+  (kube_batch_tpu/obs SLO accountant);
 - ``GET|POST /apis/v1alpha1/queues`` and
   ``DELETE /apis/v1alpha1/queues/<name>`` — the queue CRD surface the
   reference CLI talks to (pkg/cli/queue);
@@ -59,7 +63,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kube_batch_tpu import faults, log, metrics, version
+from kube_batch_tpu import faults, log, metrics, obs, version
 from kube_batch_tpu.apis.types import ObjectMeta, Queue, QueueSpec
 from kube_batch_tpu.cache import ClusterStore, SchedulerCache
 from kube_batch_tpu.cache.store import KINDS, AlreadyExists, EventHandler, StaleWrite
@@ -537,6 +541,10 @@ def _make_handler(server: "SchedulerServer"):
             parsed = urllib.parse.urlsplit(self.path)
             path = parsed.path
             if path == "/metrics":
+                # Refresh the SLO quantile gauges from the sliding
+                # windows right before exposition — scrape-time freshness
+                # without a publisher thread.
+                obs.slo.publish()
                 self._reply(
                     200, metrics.render_prometheus_text(), "text/plain; version=0.0.4"
                 )
@@ -544,6 +552,21 @@ def _make_handler(server: "SchedulerServer"):
                 self._reply(200, "ok", "text/plain")
             elif path == "/version":
                 self._reply(200, "\n".join(version.info()) + "\n", "text/plain")
+            elif path == "/debug/trace":
+                # Flight-recorder peek: the bounded ring of recent cycle
+                # traces. ``?dump=1`` additionally writes the jsonl +
+                # Chrome trace files and returns their paths.
+                query = urllib.parse.parse_qs(parsed.query)
+                payload = {
+                    "enabled": obs.enabled(),
+                    "traces": obs.recorder.trace_count(),
+                    "spans": obs.recorder.spans(),
+                }
+                if query.get("dump", ["0"])[0] not in ("", "0", "false"):
+                    payload["dump"] = obs.recorder.dump(reason="debug_endpoint")
+                self._reply(200, json.dumps(payload))
+            elif path == "/debug/slo":
+                self._reply(200, json.dumps(obs.slo.snapshot()))
             elif path == "/backend/v1/version":
                 # Store-backend protocol (cache/backend.py): the store
                 # version optimistic writes are checked against.
@@ -675,7 +698,21 @@ def _make_handler(server: "SchedulerServer"):
                             )
                         bindings.append(tuple(str(x) for x in entry))
                     version = int(body.get("snapshotVersion", 0))
-                    applied = server.store.conditional_bind_many(bindings, version)
+                    # Store-side half of the distributed bind trace: the
+                    # client (cache/backend.py) sends its gang.bind span
+                    # context in X-KBT-* headers; parenting on it makes a
+                    # federated conflict retry one connected trace across
+                    # scheduler and arbiter processes.
+                    with obs.span(
+                        "store.bind",
+                        parent=obs.from_headers(self.headers),
+                        binds=len(bindings),
+                        version=version,
+                    ) as bspan:
+                        applied = server.store.conditional_bind_many(
+                            bindings, version
+                        )
+                        bspan.set_attr("applied", len(applied))
                     self._reply(
                         200,
                         json.dumps(
@@ -1283,6 +1320,11 @@ def run(argv: Optional[list[str]] = None) -> None:
             "--lock-file or --lease-url must be set when --leader-elect is enabled"
         )
     log.set_verbosity(opt.v)
+    # Last-gasp observability: dump the flight-recorder ring on SIGTERM
+    # (chains any previously-installed handler). SIGKILL can't be
+    # caught — that story is the dump-on-fault/abort paths plus the
+    # journal trace links.
+    obs.install_signal_dump()
 
     elector = None
     if opt.leader_elect:

@@ -41,7 +41,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from kube_batch_tpu import metrics
+from kube_batch_tpu import metrics, obs
 from kube_batch_tpu.api.job_info import get_job_id, job_key
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.cache.store import NODES, POD_GROUPS, PODS, QUEUES
@@ -146,6 +146,7 @@ class StreamTrigger:
         self._gangs: set[str] = set()  #: guarded_by _lock
         self._node_patches: dict[str, Optional[object]] = {}  #: guarded_by _lock
         self._arrivals: dict[str, float] = {}  #: guarded_by _lock  (pod uid -> arrival stamp)
+        self._queues: dict[str, str] = {}  #: guarded_by _lock  (gang key -> queue name)
         self._stale = False  #: guarded_by _lock
         self._stale_reason = ""  #: guarded_by _lock
         # _attached is loop-thread-confined (attach/detach both run on
@@ -221,7 +222,15 @@ class StreamTrigger:
             self._event.set()
         elif kind == POD_GROUPS:
             if obj is None:
+                with self._lock:
+                    self._queues.pop(key, None)
                 return  # deletes resolve via clone_jobs_for_stream's missing set
+            # Remember the gang's queue (key is "ns/name" == job uid) so
+            # the bind echo can attribute time-to-bind to the right
+            # per-queue SLO window even before any recording below.
+            queue = getattr(getattr(obj, "spec", None), "queue", "") or "default"
+            with self._lock:
+                self._queues[key] = queue
             if old is not None and getattr(obj, "spec", None) == getattr(
                 old, "spec", None
             ):
@@ -254,9 +263,19 @@ class StreamTrigger:
                 with self._lock:
                     t0 = self._arrivals.pop(key, None)
                     backlog = len(self._arrivals)
+                    queue = self._queues.get(gang_key_of(obj), "default")
                 metrics.set_streaming_backlog(backlog)
                 if t0 is not None:
                     metrics.observe_time_to_bind(now - t0)
+                    obs.slo.observe("time_to_bind", queue, now - t0)
+                    # Synthetic span: the arrival->bind interval was
+                    # measured between two watch events, not inside a
+                    # ``with`` — emit it post-hoc onto the ambient trace
+                    # (the dispatching micro-cycle when the echo arrives
+                    # on the loop thread, else its own root).
+                    obs.emit(
+                        "time_to_bind", t0, now, queue=queue, pod=key,
+                    )
             elif old.node_name and not obj.node_name:
                 with self._lock:
                     self._gangs.add(gang_key_of(obj))
