@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Diff two bench result files — the regression gate for BENCH_*.json.
+
+``bench.py`` emits its per-row numbers as a ``{"details": {row: {...}}}``
+JSON line on stderr; the repo's archived ``BENCH_r*.json`` artifacts wrap
+that whole invocation as ``{"n", "cmd", "rc", "tail", "parsed"}`` with
+the details line embedded somewhere inside the ``tail`` string. This
+tool accepts EITHER form on either side (plus a bare row-mapping), so
+
+    python hack/bench_diff.py BENCH_r05.json BENCH_r06.json
+
+compares two archived rounds and
+
+    python hack/bench_diff.py old.json new.json --strict
+
+gates a fresh run against a baseline in CI (also reachable as
+``python hack/verify.py --bench-diff OLD NEW``).
+
+Three classes of finding, each printed as one line:
+
+- ``regression``: a row's p50 latency (``p50_s``, falling back to
+  ``xla_s`` on rows without percentiles) grew by more than
+  ``--threshold`` (default 15%);
+- ``parity``: a parity bit (``placements_equal_serial``,
+  ``placements_equal_full_cycle``) that was true in OLD is false or
+  gone in NEW — the device solver stopped matching its oracle, which
+  no latency number excuses;
+- ``compiles``: a compile-budget change — ``measured_compiles`` (or
+  ``warm_encode_compiles``) grew, meaning a row started paying
+  trace+compile inside its measured repeats.
+
+Rows present on only one side are reported (``added``/``removed``) but
+only ``removed`` counts as a finding: a vanished row is a silently
+narrowed bench. Improvements are listed informationally.
+
+``--json`` emits one machine-readable summary line; ``--strict`` exits
+nonzero when any finding fired (default exit is 0 — informational).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+# latency key preference per row: tail-honest median first
+_LATENCY_KEYS = ("p50_s", "xla_s")
+_PARITY_KEYS = ("placements_equal_serial", "placements_equal_full_cycle")
+_COMPILE_KEYS = ("measured_compiles", "warm_encode_compiles")
+
+
+def _rows_from_obj(obj):
+    """Extract the row mapping from any of the accepted shapes."""
+    if not isinstance(obj, dict):
+        return None
+    if isinstance(obj.get("details"), dict):
+        return obj["details"]
+    if isinstance(obj.get("tail"), str):
+        # driver wrapper: scan the captured output for the stderr
+        # details line (bench.py prints exactly one such object)
+        for line in obj["tail"].splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                inner = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(inner, dict) and isinstance(
+                inner.get("details"), dict
+            ):
+                return inner["details"]
+        return _rows_from_fragment(obj["tail"])
+    # bare mapping of row name -> row dict
+    if obj and all(isinstance(v, dict) for v in obj.values()):
+        return obj
+    return None
+
+
+def _rows_from_fragment(text: str) -> dict | None:
+    """Recover rows from a FRONT-TRUNCATED details line: the archived
+    wrappers keep only the trailing bytes of stderr, so the
+    ``{"details": {`` prefix (and possibly the first row) may be cut
+    off mid-object. Scan for ``"name": {...}`` pairs and keep every
+    object that carries a bench latency key — partial first rows
+    simply fail to decode and are skipped."""
+    dec = json.JSONDecoder()
+    rows = {}
+    for m in re.finditer(r'"([A-Za-z0-9_./:-]+)":\s*\{', text):
+        try:
+            row, _ = dec.raw_decode(text, m.end() - 1)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and any(k in row for k in _LATENCY_KEYS):
+            rows[m.group(1)] = row
+    return rows or None
+
+
+def load_rows(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        obj = json.load(fh)
+    rows = _rows_from_obj(obj)
+    if rows is None:
+        raise SystemExit(
+            f"bench_diff: {path}: no bench rows found (expected a "
+            '{"details": ...} object, a BENCH_*.json wrapper whose tail '
+            "embeds one, or a bare row mapping)"
+        )
+    return rows
+
+
+def _latency(row: dict):
+    for k in _LATENCY_KEYS:
+        v = row.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return k, float(v)
+    return None, None
+
+
+def diff_rows(old: dict, new: dict, threshold: float) -> dict:
+    findings = []
+    improvements = []
+    added = sorted(set(new) - set(old))
+    removed = sorted(set(old) - set(new))
+    for name in removed:
+        findings.append({
+            "row": name, "kind": "removed",
+            "msg": f"{name}: row present in OLD but missing from NEW "
+                   "(bench coverage narrowed)",
+        })
+    for name in sorted(set(old) & set(new)):
+        o, n = old[name], new[name]
+        ok_key, ov = _latency(o)
+        nk_key, nv = _latency(n)
+        if ov is not None and nv is not None:
+            delta = (nv - ov) / ov
+            key = nk_key if nk_key == ok_key else f"{ok_key}->{nk_key}"
+            if delta > threshold:
+                findings.append({
+                    "row": name, "kind": "regression",
+                    "msg": f"{name}: {key} {ov:.4f}s -> {nv:.4f}s "
+                           f"(+{delta:.1%}, threshold {threshold:.0%})",
+                })
+            elif delta < -threshold:
+                improvements.append(
+                    f"{name}: {key} {ov:.4f}s -> {nv:.4f}s ({delta:.1%})"
+                )
+        for k in _PARITY_KEYS:
+            if o.get(k) is True and n.get(k) is not True:
+                state = "flipped false" if k in n else "vanished"
+                findings.append({
+                    "row": name, "kind": "parity",
+                    "msg": f"{name}: {k} {state} (was true in OLD)",
+                })
+        for k in _COMPILE_KEYS:
+            oc, nc = o.get(k), n.get(k)
+            if isinstance(nc, (int, float)) and nc > (
+                oc if isinstance(oc, (int, float)) else 0
+            ):
+                findings.append({
+                    "row": name, "kind": "compiles",
+                    "msg": f"{name}: {k} {oc if oc is not None else 0} "
+                           f"-> {nc} (measured repeats started compiling)",
+                })
+    return {
+        "rows_old": len(old),
+        "rows_new": len(new),
+        "added": added,
+        "removed": removed,
+        "findings": findings,
+        "improvements": improvements,
+        "ok": not findings,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="Diff two bench result files (regressions, parity "
+                    "flips, compile-budget changes).",
+    )
+    ap.add_argument("old", help="baseline bench JSON (details/wrapper/rows)")
+    ap.add_argument("new", help="candidate bench JSON (same shapes accepted)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative p50 regression threshold (default 0.15)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one machine-readable summary line")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when any finding fired")
+    args = ap.parse_args(argv)
+
+    summary = diff_rows(
+        load_rows(args.old), load_rows(args.new), args.threshold
+    )
+    for f in summary["findings"]:
+        print(f"bench_diff: [{f['kind']}] {f['msg']}")
+    for line in summary["improvements"]:
+        print(f"bench_diff: [improved] {line}")
+    for name in summary["added"]:
+        print(f"bench_diff: [added] {name}: new row in NEW")
+    print(
+        "bench_diff:",
+        "ok" if summary["ok"] else f"{len(summary['findings'])} finding(s)",
+        f"({summary['rows_old']} -> {summary['rows_new']} rows,"
+        f" threshold {args.threshold:.0%})",
+    )
+    if args.as_json:
+        print(json.dumps(summary, sort_keys=True))
+    return 1 if (args.strict and not summary["ok"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

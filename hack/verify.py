@@ -48,7 +48,17 @@ Runs, in order:
    (check_tree clean) whose conflicted gang.bind joins the arbiter's
    store.bind spans in one trace (cross-process propagation over the
    backend headers), fsck-clean, with the JSONL + Chrome trace pair
-   exported. ``--obs`` requests it explicitly; it runs by default.
+   exported. ``--obs`` requests it explicitly; it runs by default;
+10. the explain forensics smoke (python -m kube_batch_tpu.obs.explain
+    --json): on a seeded cluster with one stuck gang per feasibility
+    plane, the batched device forensics must match the serial twin
+    byte-for-byte, report each gang's designed dominant reason and
+    would-fit-if planes, and land those reasons on PodGroup conditions.
+
+With ``--bench-diff OLD NEW``, two bench artifacts (fresh bench.py
+output or archived BENCH_*.json wrappers) are regression-gated via
+hack/bench_diff.py --strict: >15% p50 regressions, parity flips,
+compile-budget changes and vanished rows all fail the gate.
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
 (tests/test_faults.py + tests/test_recovery.py + tests/test_federation.py
@@ -67,6 +77,7 @@ leave store truth fsck-clean.
 Exit 0 iff every gate is clean.
 Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
                               [--obs] [--interleave] [--json]
+                              [--bench-diff OLD.json NEW.json]
 
 ``--json`` appends one machine-readable summary line to stdout
 (per-gate pass/fail + finding counts) so bench/CI can record the
@@ -454,6 +465,70 @@ def run_obs_gate(env: dict) -> dict:
     }
 
 
+def run_explain_gate(env: dict) -> dict:
+    """Default gate: the unschedulability-forensics self-check
+    (python -m kube_batch_tpu.obs.explain --json). A seeded cluster
+    with one stuck gang per feasibility plane plus a bound control:
+    the batched device forensics must agree byte-for-byte with the
+    serial twin (parity), every gang must report its designed dominant
+    reason, the would-fit-if planes must flag the designed single
+    fixes, and the reasons must land on PodGroup conditions."""
+    import json
+
+    env = dict(env)
+    # an explain/tracing override armed in the shell would skew the
+    # smoke (it arms KBT_EXPLAIN itself)
+    for var in ("KBT_EXPLAIN", "KBT_TRACE", "KBT_FEDERATION",
+                "KBT_SHARD_KEY", "KBT_FLIGHT_RECORDER"):
+        env.pop(var, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.obs.explain", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    summary: dict = {}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: explain forensics smoke produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and summary.get("ok", False)
+    if not ok:
+        print(f"verify: explain forensics smoke FAILED ({summary})")
+    return {
+        "ok": ok,
+        "parity": summary.get("parity"),
+        "reasons_ok": summary.get("reasons_ok"),
+        "would_fit_if_ok": summary.get("would_fit_if_ok"),
+        "conditions_ok": summary.get("conditions_ok"),
+    }
+
+
+def run_bench_diff_gate(old: str, new: str) -> dict:
+    """--bench-diff OLD NEW: hack/bench_diff.py in --strict mode — a
+    >15% p50 regression, a parity flip, a compile-budget change or a
+    vanished row in NEW vs OLD fails the gate."""
+    import json
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "bench_diff.py"),
+         old, new, "--json", "--strict"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    summary: dict = {}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: bench_diff produced no parseable summary")
+    if res.returncode != 0 or not summary.get("ok", False):
+        print(res.stdout.rstrip())
+        print(f"verify: bench diff FAILED ({old} -> {new})")
+    return {
+        "ok": res.returncode == 0 and summary.get("ok", False),
+        "findings": len(summary.get("findings", [])),
+        "rows": summary.get("rows_new"),
+    }
+
+
 def run_analysis_gate(strict: bool) -> dict:
     """The domain-aware suite as a subprocess (same pattern as the fsck
     gate: the CLI is the contract). Returns a summary dict for --json."""
@@ -576,6 +651,15 @@ def main(argv: list[str] | None = None) -> int:
     as_json = "--json" in argv
     interleave = "--interleave" in argv
     federation = "--federation" in argv
+    bench_diff: tuple[str, str] | None = None
+    if "--bench-diff" in argv:
+        i = argv.index("--bench-diff")
+        if len(argv) < i + 3 or argv[i + 1].startswith("--") \
+                or argv[i + 2].startswith("--"):
+            print("verify: --bench-diff takes two bench JSON paths (OLD NEW)")
+            return 2
+        bench_diff = (argv[i + 1], argv[i + 2])
+        argv = argv[:i] + argv[i + 3:]
     unknown = [
         a for a in argv
         if a not in ("--strict", "--chaos", "--json", "--interleave",
@@ -741,6 +825,13 @@ def main(argv: list[str] | None = None) -> int:
     if not gates["obs_tracing_smoke"]["ok"]:
         failed = True
 
+    # 7c-bis. explain forensics smoke: batched device forensics vs the
+    # serial twin on the seeded per-plane stuck-gang cluster (python -m
+    # kube_batch_tpu.obs.explain). Part of the default gate set.
+    gates["explain_smoke"] = run_explain_gate(env)
+    if not gates["explain_smoke"]["ok"]:
+        failed = True
+
     # 7d. --federation: the wire-path smoke + the seeded two-scheduler
     # conflict drill (optimistic concurrency over the extracted backend)
     if federation:
@@ -753,6 +844,14 @@ def main(argv: list[str] | None = None) -> int:
         chaos_ok = run_chaos_gate(env)
         gates["chaos"] = {"ok": chaos_ok}
         if not chaos_ok:
+            failed = True
+
+    # 9. --bench-diff OLD NEW: regression-gate two bench artifacts
+    # (hack/bench_diff.py --strict — p50 regressions, parity flips,
+    # compile-budget changes, vanished rows)
+    if bench_diff is not None:
+        gates["bench_diff"] = run_bench_diff_gate(*bench_diff)
+        if not gates["bench_diff"]["ok"]:
             failed = True
 
     print("verify:", "FAILED" if failed else "ok",

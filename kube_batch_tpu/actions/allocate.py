@@ -16,7 +16,7 @@ job batch.
 
 from __future__ import annotations
 
-from kube_batch_tpu import log
+from kube_batch_tpu import log, obs
 from kube_batch_tpu.api.job_info import TaskInfo
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.types import TaskStatus
@@ -142,6 +142,19 @@ class AllocateAction(Action):
 
             # Round-robin the queue until it has no jobs left (allocate.go:189).
             queues.push(queue)
+
+        # Post-solve forensics (obs/explain): the serial action is the
+        # correctness-oracle side of explain parity, re-encoding the
+        # closed-over world and walking the planes task by task. Covers
+        # both direct serial confs and every xla_allocate fallback.
+        from kube_batch_tpu.obs import explain as _explain
+
+        if _explain.enabled():
+            with obs.span("explain") as sp:
+                recs = _explain.explain_session(ssn)
+                _explain.publish(ssn, recs)
+                for k, v in _explain.summary(recs).items():
+                    sp.set_attr(k, v)
 
 
 def new() -> Action:

@@ -364,6 +364,7 @@ class XlaAllocateAction(Action):
             return
         t_solve = _time.perf_counter() - t0
         t0 = _time.perf_counter()
+        t_explain = 0.0
         with obs.span("gang.assign", assigned=int(result.n_assigned)):
             replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
             if budget is not None:
@@ -372,12 +373,29 @@ class XlaAllocateAction(Action):
                 # cycle.overrun drill injects here (inject=True) — maximal
                 # discardable work, zero cache mutation.
                 budget.check("dispatch barrier", inject=True)
+            # Post-solve forensics (obs/explain): batched plane/score
+            # reductions against the FINAL solver state, published before
+            # replay.finish so the journal intents it writes can attach
+            # per-gang reason payloads — and after the budget gate, so an
+            # aborted cycle leaves no half-cycle records behind.
+            from kube_batch_tpu.obs import explain as _explain
+
+            if _explain.enabled():
+                te = _time.perf_counter()
+                with obs.span("explain", micro=micro) as xsp:
+                    recs = _explain.explain_post_solve(ssn, enc, arrays, state, result)
+                    _explain.publish(ssn, recs)
+                    for k, v in _explain.summary(recs).items():
+                        xsp.set_attr(k, v)
+                t_explain = _time.perf_counter() - te
             replay.finish(np.asarray(result.ready_cnt))
         self.last_timings = {
             "encode_s": t_encode,
             "solve_s": t_solve,
-            "replay_s": _time.perf_counter() - t0,
+            "replay_s": _time.perf_counter() - t0 - t_explain,
         }
+        if t_explain:
+            self.last_timings["explain_s"] = t_explain
 
     def _mesh_requested(self, ssn: Session) -> bool:
         """True when the conf/env names a mesh at all — resolution may

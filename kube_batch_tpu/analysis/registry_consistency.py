@@ -1,4 +1,4 @@
-"""A3 — registry-consistency analyzer (KBT-R001..R006).
+"""A3 — registry-consistency analyzer (KBT-R001..R011).
 
 Three registries grew to dozens of names across PR 1-3, each previously
 checked only by grep and luck:
@@ -36,6 +36,12 @@ checked only by grep and luck:
   an undeclared route escapes the contract, a declared-but-unserved
   one 404s), and every declared endpoint needs a row in the deployment
   runbook's endpoint table, with no dead documented rows (R010).
+- **metric help text**: every module-level Counter/Histogram/Gauge in
+  ``metrics/__init__.py`` must carry non-empty help text and appear in
+  ``render_prometheus_text``'s families list, and every families entry
+  must be a declared metric (R011) — a helpless or unlisted metric is
+  a series Prometheus scrapes without ``# HELP``/``# TYPE`` or never
+  sees at all.
 """
 
 from __future__ import annotations
@@ -447,6 +453,111 @@ def _check_debug_endpoints(
             )
 
 
+# -- metric help text + exposition families (R011) ---------------------------
+
+_METRIC_CLASSES = ("Counter", "Histogram", "Gauge")
+
+
+def _metric_decls(files: list[SourceFile]) -> dict[str, tuple[int, bool]]:
+    """name -> (lineno, has_help) for every module-level metric object
+    assignment in metrics/__init__.py."""
+    out: dict[str, tuple[int, bool]] = {}
+    for sf in files:
+        if sf.path != METRICS_MODULE:
+            continue
+        mod = sf.tree
+        if not isinstance(mod, ast.Module):
+            continue
+        for node in mod.body:
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            fn = node.value.func
+            cls = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            if cls not in _METRIC_CLASSES:
+                continue
+            args = node.value.args
+            help_arg = args[1] if len(args) > 1 else None
+            for kw in node.value.keywords:
+                if kw.arg == "help_text":
+                    help_arg = kw.value
+            has_help = (
+                isinstance(help_arg, ast.Constant)
+                and isinstance(help_arg.value, str)
+                and bool(help_arg.value.strip())
+            ) or isinstance(help_arg, ast.JoinedStr)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = (node.lineno, has_help)
+    return out
+
+
+def _exposition_families(files: list[SourceFile]) -> dict[str, int]:
+    """name -> lineno for every entry of the ``families = [...]`` list
+    inside render_prometheus_text."""
+    out: dict[str, int] = {}
+    for sf in files:
+        if sf.path != METRICS_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "render_prometheus_text"
+            ):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id == "families"
+                            and isinstance(sub.value, (ast.List, ast.Tuple))
+                        ):
+                            for e in sub.value.elts:
+                                if isinstance(e, ast.Name):
+                                    out.setdefault(e.id, e.lineno)
+    return out
+
+
+def _check_metric_help(files: list[SourceFile], findings: list[Finding]) -> None:
+    declared = _metric_decls(files)
+    if not declared:
+        return
+    families = _exposition_families(files)
+    for name, (lineno, has_help) in sorted(declared.items()):
+        if not has_help:
+            findings.append(
+                Finding(
+                    METRICS_MODULE, lineno, "KBT-R011",
+                    f"metric {name!r} is declared without help text — its "
+                    "exposition would carry an empty # HELP line",
+                    symbol=f"metric:{name}",
+                )
+            )
+        if families and name not in families:
+            findings.append(
+                Finding(
+                    METRICS_MODULE, lineno, "KBT-R011",
+                    f"metric {name!r} is declared but missing from "
+                    "render_prometheus_text's families list — Prometheus "
+                    "never sees the series",
+                    symbol=f"metric:{name}",
+                )
+            )
+    for name, lineno in sorted(families.items()):
+        if name not in declared:
+            findings.append(
+                Finding(
+                    METRICS_MODULE, lineno, "KBT-R011",
+                    f"families entry {name!r} is not a module-level metric "
+                    "declaration — the exposition renders an unregistered "
+                    "object",
+                    symbol=f"metric:{name}",
+                )
+            )
+
+
 # -- env knobs ---------------------------------------------------------------
 
 
@@ -558,5 +669,6 @@ def analyze(
     _check_state_seq(files, findings)
     _check_span_names(files, findings)
     _check_debug_endpoints(files, repo, runbook, findings)
+    _check_metric_help(files, findings)
     _check_env(files, repo, runbook, findings)
     return findings

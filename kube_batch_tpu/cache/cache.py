@@ -980,10 +980,24 @@ class SchedulerCache:
             # id so a takeover's reconciliation can name the trace that
             # wrote each intent it re-litigates
             cur = obs.current()
+            # explain payloads (obs/explain): the allocate action
+            # publishes per-gang forensics into the process registry
+            # before dispatch reaches here, so each intent can carry the
+            # compact (verdict, reason) tuple of the decision it records
+            explain = None
+            from kube_batch_tpu.obs import explain as _explain
+
+            if _explain.enabled():
+                explain = {}
+                for gang in {e[0] for e in entries}:
+                    payload = _explain.intent_payload(gang)
+                    if payload is not None:
+                        explain[gang] = payload
             with obs.span("journal.append", op=op, n=len(entries)) as jspan:
                 seqs = self.journal.append_intents(
                     op, entries, cycle=self.cycle,
                     trace=cur.trace_id if cur is not None else "",
+                    explain=explain,
                 )
                 jspan.set_attr("first_seq", seqs[0] if seqs else None)
                 return seqs

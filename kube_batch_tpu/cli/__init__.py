@@ -8,6 +8,7 @@ clientset; here the scheduler server's HTTP queue API
     kbt-ctl queue create --name q1 --weight 3
     kbt-ctl queue list
     kbt-ctl queue delete --name q1
+    kbt-ctl explain --gang default/my-gang
     kbt-ctl version
 
 `--server` points at the scheduler's listen address (the reference's

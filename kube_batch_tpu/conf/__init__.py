@@ -111,6 +111,12 @@ class SchedulerConfiguration:
     flips tracing on a live scheduler on its next cycle::
 
         trace: on
+
+    and ``explain``: the unschedulability-forensics switch
+    (kube_batch_tpu.obs.explain; env KBT_EXPLAIN is the process-wide
+    equivalent, empty defers to it). Hot-reloadable like ``trace``::
+
+        explain: on
     """
 
     actions: str = ""
@@ -119,6 +125,7 @@ class SchedulerConfiguration:
     faults: str = ""
     streaming: bool = False
     trace: str = ""
+    explain: str = ""
 
 
 # Default conf (reference util.go:31-42).
@@ -152,6 +159,7 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
         faults=str(data.get("faults") or ""),
         streaming=bool(data.get("streaming", False)),
         trace=str(data.get("trace") if data.get("trace") is not None else ""),
+        explain=str(data.get("explain") if data.get("explain") is not None else ""),
     )
     for action_name, args in (data.get("actionArguments") or {}).items():
         conf.action_arguments[str(action_name)] = {

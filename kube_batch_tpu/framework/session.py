@@ -73,6 +73,13 @@ class Session:
         # mesh selection). Keyed by action name.
         self.action_arguments: dict[str, dict[str, str]] = {}
 
+        # Per-gang unschedulability forensics published by the allocate
+        # actions when KBT_EXPLAIN is on (obs/explain.py); empty when
+        # explain is off or no allocate action ran. Keyed by JobInfo.uid.
+        # Read by the gang plugin (condition messages), the journal
+        # intent writer, and the flight-recorder span summaries.
+        self.explain_records: dict[str, dict] = {}
+
         self.plugins: dict[str, Plugin] = {}
         self.event_handlers: list[EventHandler] = []
         self.job_order_fns: dict[str, Callable] = {}

@@ -315,6 +315,18 @@ store_backend_rtt = Histogram(
     FINE_BUCKETS,
 )
 
+# -- unschedulability forensics (kube_batch_tpu.obs.explain) -----------------
+unschedulable_total = Counter(
+    f"{_SUBSYSTEM}_unschedulable_total",
+    "Gangs left unschedulable by an allocate cycle, by dominant reason "
+    "(static/room/ports/resources/starved)",
+)
+would_fit_if_total = Counter(
+    f"{_SUBSYSTEM}_would_fit_if_total",
+    "Single-plane relaxations that would make an unschedulable gang "
+    "feasible, by plane",
+)
+
 # -- per-queue SLO windows (kube_batch_tpu.obs SLOAccountant) ----------------
 # Sliding-window quantiles, refreshed by obs.slo.publish() at scrape
 # time — unlike the cumulative histograms above, these answer "is queue
@@ -463,6 +475,14 @@ def observe_store_backend_rtt(op: str, seconds: float) -> None:
     store_backend_rtt.observe(seconds, {"op": op})
 
 
+def register_unschedulable(reason: str) -> None:
+    unschedulable_total.inc({"reason": reason})
+
+
+def register_would_fit_if(plane: str) -> None:
+    would_fit_if_total.inc({"plane": plane})
+
+
 def set_slo_quantile(kind: str, queue: str, quantile: str, value: float) -> None:
     """One SLO window quantile (kind in obs.SLOAccountant.KINDS)."""
     gauge = _SLO_GAUGES.get(kind)
@@ -554,6 +574,8 @@ def render_prometheus_text() -> str:
         federation_conflicts,
         bind_retries,
         store_backend_rtt,
+        unschedulable_total,
+        would_fit_if_total,
         slo_time_to_bind,
         slo_queue_wait,
     ]

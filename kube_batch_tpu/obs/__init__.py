@@ -107,12 +107,13 @@ SPAN_NAMES = (
     "gang.bind",      # one gang's store write, conflict retries as events
     "store.bind",     # store-arbiter side of a conditional bind (remote)
     "time_to_bind",   # synthetic: streaming arrival -> bind echo, per pod
+    "explain",        # post-solve unschedulability forensics (obs/explain)
 )
 
 # Every /debug/* route server.py serves. Checked both directions by the
 # KBT-R analyzer (R009/R010) against server.py literals and the runbook
 # endpoint table.
-DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo")
+DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo", "/debug/explain")
 
 # Wall/perf anchor pair: spans are stamped with the monotonic clock (so
 # durations survive NTP steps) and exported in wall-clock microseconds
@@ -677,15 +678,24 @@ def smoke(
        that a ``gang.bind`` span carries a conflict event, and that a
        ``store.bind`` span recorded on the arbiter side joined a
        scheduler-originated trace (cross-process propagation);
-    4. export the Chrome trace-event file + JSONL and return the paths.
+    4. seed one deliberately unfittable gang and assert its explain
+       record (obs/explain, armed alongside tracing) lands in the
+       forensics registry, rides an ``explain`` span in the flight
+       recorder, and that dispatched gangs' journal intents carry
+       ``explain`` payloads;
+    5. export the Chrome trace-event file + JSONL and return the paths.
     """
+    import json as _json
     import threading as _threading
 
     from kube_batch_tpu import faults
     from kube_batch_tpu.cache import LoopbackBackend
-    from kube_batch_tpu.federation import FederatedCache, _seed_world, _wait_all_bound, fsck
+    from kube_batch_tpu.federation import FederatedCache, _seed_world, fsck
+    from kube_batch_tpu.obs import explain as _explain
+    from kube_batch_tpu.recovery.journal import WriteIntentJournal
     from kube_batch_tpu.scheduler import Scheduler
     from kube_batch_tpu.server import SchedulerServer
+    from kube_batch_tpu.testing import build_pod, build_pod_group, build_resource_list
 
     # Arm through the env var, not configure() directly: every
     # scheduler cycle re-resolves the switch from conf/env (hot
@@ -693,17 +703,23 @@ def smoke(
     # _load_conf of a conf whose trace: key is empty.
     prev_env = os.environ.get(ENV)
     os.environ[ENV] = "1"
+    prev_explain = os.environ.get(_explain.ENV)
+    os.environ[_explain.ENV] = "1"
     # a 12-pod world is far below xla_allocate's device-size floor;
     # force the device path or the smoke would fall back to serial
     # allocate and never take the traced encode/solve/bind_many pipeline
     prev_floor = os.environ.get("KBT_MIN_DEVICE_PAIRS")
     os.environ["KBT_MIN_DEVICE_PAIRS"] = "0"
     configure()
+    _explain.configure()
     recorder.clear()
     slo.reset()
+    _explain.records.clear()
     faults.registry.configure("federation.stale_assign:1:1")
 
     total = gangs * members
+    out_dir = out_dir or os.path.join(tempfile.gettempdir(), "kbt-obs-smoke")
+    os.makedirs(out_dir, exist_ok=True)
     server = SchedulerServer(
         scheduler_name="obs-arbiter", listen_address="127.0.0.1:0",
         schedule_period=60.0,
@@ -711,18 +727,35 @@ def smoke(
     server.start()
     backends: list = []
     scheds: list = []
+    journal_paths: list[str] = []
     stop = _threading.Event()
     with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as fh:
         fh.write(SMOKE_CONF)
         conf_path = fh.name
     try:
         _seed_world(server.store, gangs, members, nodes)
+        # One deliberately unfittable gang (cpu far beyond any node):
+        # the run must leave it pending with an explain record whose
+        # dominant reason is the resources plane.
+        server.store.create_pod_group(build_pod_group("fg-stuck", min_member=1))
+        server.store.create_pod(
+            build_pod(
+                name="fg-stuck-p0",
+                group_name="fg-stuck",
+                req=build_resource_list(cpu=999, memory="512Mi"),
+            )
+        )
         base = f"http://127.0.0.1:{server.listen_port}"
         for i in range(shards):
             backend = LoopbackBackend(base)
+            jpath = os.path.join(out_dir, f"smoke-journal-{i}.jsonl")
+            if os.path.exists(jpath):
+                os.unlink(jpath)
+            journal_paths.append(jpath)
             cache = FederatedCache(
                 backend, shard=i, shards=shards, shard_key="gang",
                 staleness_fn=backend.snapshot_age,
+                journal=WriteIntentJournal(jpath),
             )
             cache.run()
             backend.start(period=0.02)
@@ -735,7 +768,18 @@ def smoke(
             )
             t.start()
             scheds.append((sched, t))
-        all_bound = _wait_all_bound(server.store, total, deadline_s=60.0)
+        # the stuck pod never binds, so wait on the bound COUNT, not on
+        # every pod carrying a node (the federation helper's criterion)
+        from kube_batch_tpu.cache.store import PODS as _PODS
+
+        deadline = time.monotonic() + 60.0
+        all_bound = False
+        while time.monotonic() < deadline:
+            pods = server.store.list(_PODS)
+            if sum(1 for p in pods if p.node_name) >= total:
+                all_bound = True
+                break
+            time.sleep(0.005)
     finally:
         stop.set()
         for _, t in scheds:
@@ -762,8 +806,30 @@ def smoke(
         if s["name"] == "store.bind" and s["trace_id"] in scheduler_traces
     ]
 
-    out_dir = out_dir or os.path.join(tempfile.gettempdir(), "kbt-obs-smoke")
-    os.makedirs(out_dir, exist_ok=True)
+    # Explain assertions (obs/explain): the unfittable gang's record is
+    # in the registry with the designed dominant reason, an explain span
+    # carrying unschedulable forensics rode the flight recorder, and at
+    # least one dispatched gang's journal intent carries the explain
+    # payload (the labeled-decision channel).
+    stuck_rec = _explain.records.get("default/fg-stuck")
+    explain_spans = [
+        s for s in spans
+        if s["name"] == "explain" and s["attrs"].get("unschedulable", 0) > 0
+    ]
+    journaled_explains = 0
+    for jpath in journal_paths:
+        try:
+            with open(jpath, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = _json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("rec") == "intent" and "explain" in rec:
+                        journaled_explains += 1
+        except OSError:
+            pass
+
     jsonl_path = export_jsonl(spans, os.path.join(out_dir, "smoke.jsonl"))
     chrome_path = export_chrome(spans, os.path.join(out_dir, "smoke.trace.json"))
 
@@ -771,11 +837,16 @@ def smoke(
         os.environ.pop(ENV, None)
     else:
         os.environ[ENV] = prev_env
+    if prev_explain is None:
+        os.environ.pop(_explain.ENV, None)
+    else:
+        os.environ[_explain.ENV] = prev_explain
     if prev_floor is None:
         os.environ.pop("KBT_MIN_DEVICE_PAIRS", None)
     else:
         os.environ["KBT_MIN_DEVICE_PAIRS"] = prev_floor
     configure()
+    _explain.configure()
     result = {
         "shards": shards,
         "pods": total,
@@ -789,6 +860,9 @@ def smoke(
         "slo": slo.snapshot(),
         "jsonl": jsonl_path,
         "chrome_trace": chrome_path,
+        "stuck_gang_reason": stuck_rec["reason"] if stuck_rec else None,
+        "explain_spans": len(explain_spans),
+        "journaled_explains": journaled_explains,
     }
     result["ok"] = bool(
         all_bound
@@ -799,6 +873,10 @@ def smoke(
         and names.get("gang.bind", 0) > 0
         and conflict_binds
         and joined_remote
+        and stuck_rec is not None
+        and stuck_rec["verdict"] == "unschedulable"
+        and explain_spans
+        and journaled_explains > 0
     )
     return result
 

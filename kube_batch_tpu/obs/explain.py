@@ -1,0 +1,696 @@
+"""Decision observability: unschedulability forensics + placement provenance.
+
+PR 12 tentpole (ISSUE.md). Tracing (obs/__init__) answers "where did the
+cycle's time go"; this module answers the operator's *first* question —
+"why didn't gang X bind, and what single constraint relaxation would fix
+it?" — plus the training-data question ROADMAP item 5 asks: every
+decision leaves a labeled (state, decision, reason) record.
+
+After every solve, each gang that still has a pending task gets a
+forensics record computed from the final arena tensors by the batched
+kernel in ops/explain (plane elimination counts, leave-one-plane-out
+would-fit-if verdicts, top-k near-miss nodes); gangs that bound with no
+pending remainder get a light provenance record derived from the
+session. The serial allocate action computes byte-identical records
+task-by-task through a post-action re-encode (`explain_session`), so
+explain parity is pinned serial = XLA = mesh exactly like placement
+parity. Records flow out through every existing channel:
+
+- ``/debug/explain?gang=...`` on server.py (registry snapshot + aggregate);
+- an ``explain`` span on the cycle/micro-cycle trace carrying the
+  summary, so forensics ride the flight recorder;
+- ``kube_batch_tpu_unschedulable_total{reason}`` and
+  ``kube_batch_tpu_would_fit_if_total{plane}`` counters;
+- PodGroup Unschedulable conditions (the gang plugin swaps its generic
+  reason/message for the explain record's at session close), which is
+  also the federation cross-shard aggregation channel — shard commits
+  push conditions through ``/backend/v1/`` into the arbiter store, and
+  :func:`aggregate_conditions` folds them back together;
+- an ``explain`` field on journal intent records (replay ignores
+  unknown keys), giving the bind-intent journal labeled decision tuples.
+
+Off by default. Armed with ``KBT_EXPLAIN=1`` or the hot-reloadable conf
+``explain:`` key; when off, every entry point is one module-bool check
+(same no-op discipline as ``KBT_TRACE``, pinned by the overhead guard
+test). ``python -m kube_batch_tpu.obs.explain --json`` runs the seeded
+self-check: one forced-unschedulable gang per plane class, serial/XLA
+record parity, reason-per-plane verdicts, and flight-recorder presence.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+
+from kube_batch_tpu import log
+
+__all__ = [
+    "ENV",
+    "TOP_K",
+    "PLANES",
+    "REASON_STARVED",
+    "REASON_BOUND",
+    "enabled",
+    "configure",
+    "records",
+    "explain_post_solve",
+    "explain_session",
+    "publish",
+    "summary",
+    "condition_message",
+    "intent_payload",
+    "aggregate",
+    "aggregate_conditions",
+    "debug_payload",
+    "smoke",
+    "main",
+]
+
+ENV = "KBT_EXPLAIN"
+TOP_K = 3
+
+# Re-exported lazily from ops.explain (importing jax here would put it
+# on the no-explain import path of every obs consumer).
+PLANES = ("static", "room", "ports", "resources")
+
+# A gang with feasible nodes that still did not reach min_available was
+# starved (queue overused, gang barrier, or another gang took the room
+# first) — no single plane eliminated it.
+REASON_STARVED = "starved"
+REASON_BOUND = "bound"
+
+_OFF_WORDS = ("", "0", "false", "off", "no")
+_enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(spec=None) -> bool:
+    """(Re)resolve the explain switch. ``spec`` is the conf ``explain:``
+    value — empty/None defers to ``KBT_EXPLAIN``. Hot-reloadable: the
+    scheduler calls this from its conf-reload path every cycle."""
+    global _enabled
+    if spec is None or str(spec).strip() == "":
+        on = os.environ.get(ENV, "").strip().lower() not in _OFF_WORDS
+    else:
+        on = str(spec).strip().lower() not in _OFF_WORDS
+    if on != _enabled:
+        log.infof("explain %s", "enabled" if on else "disabled")
+    _enabled = on
+    return on
+
+
+class _Registry:
+    """Bounded per-process record store keyed by gang uid (insertion
+    order = recency; re-publishing a gang moves it to the back). Serves
+    /debug/explain and the journal intent payload lookup."""
+
+    def __init__(self, max_records: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._records: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+        self.max_records = max_records
+
+    def update(self, recs: dict) -> None:
+        with self._lock:
+            for uid, rec in recs.items():
+                self._records.pop(uid, None)
+                self._records[uid] = rec
+            while len(self._records) > self.max_records:
+                self._records.popitem(last=False)
+
+    def get(self, uid: str) -> dict | None:
+        with self._lock:
+            return self._records.get(uid)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._records.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+
+records = _Registry()
+
+
+# -- record construction ------------------------------------------------------
+
+
+def _eligible(job, queues) -> bool:
+    """The encode shortlist's job eligibility, verbatim (ops/encode):
+    Pending-phase PodGroups wait for enqueue, unknown queues are
+    skipped — gangs the allocate actions never considered get no
+    record."""
+    from kube_batch_tpu.apis.types import PodGroupPhase
+
+    if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+        return False
+    return job.queue in queues
+
+
+def _forensics_record(
+    job, ready, minm, task, node_names, valid_cnt, elim, feasible, would,
+    nm_idx, nm_score, nm_planes, topk,
+) -> dict:
+    """One gang's full forensics record from the kernel outputs. Shared
+    by the batched and serial paths so the records are byte-identical
+    by construction — only the plane/score numbers differ per path, and
+    those are parity-pinned in ops/explain."""
+    verdict = REASON_BOUND if ready >= minm else "unschedulable"
+    feasible = int(feasible)
+    if verdict == REASON_BOUND:
+        reason = REASON_BOUND
+    elif feasible > 0:
+        reason = REASON_STARVED
+    else:
+        # Dominant reason = the cheapest single fix: among planes whose
+        # solo relaxation makes some node feasible, the one eliminating
+        # the fewest nodes (a selector-confined gang blocked on a port
+        # reads "ports", not "static"). No single-plane fix -> the
+        # largest eliminator. Ties break on plane order; both paths run
+        # this same host code on parity-pinned numbers.
+        fixes = [p for p in range(len(PLANES)) if would[p]]
+        if fixes:
+            reason = PLANES[min(fixes, key=lambda p: (int(elim[p]), p))]
+        else:
+            reason = PLANES[max(range(len(PLANES)), key=lambda p: int(elim[p]))]
+    near = []
+    for j in range(min(int(topk), int(valid_cnt))):
+        near.append(
+            {
+                "node": node_names[int(nm_idx[j])],
+                "score": float(nm_score[j]),
+                "planes": {p: bool(nm_planes[j][i]) for i, p in enumerate(PLANES)},
+            }
+        )
+    return {
+        "gang": job.uid,
+        "name": f"{job.namespace}/{job.name}",
+        "verdict": verdict,
+        "ready": int(ready),
+        "min": int(minm),
+        "reason": reason,
+        "task": f"{task.namespace}/{task.name}",
+        "nodes": int(valid_cnt),
+        "feasible": feasible,
+        "eliminated": {p: int(elim[i]) for i, p in enumerate(PLANES)},
+        "would_fit_if": {p: bool(would[i]) for i, p in enumerate(PLANES)},
+        "near_miss": near,
+    }
+
+
+def _bound_record(job) -> dict:
+    return {
+        "gang": job.uid,
+        "name": f"{job.namespace}/{job.name}",
+        "verdict": REASON_BOUND,
+        "ready": int(job.ready_task_num()),
+        "min": int(job.min_available),
+        "reason": REASON_BOUND,
+    }
+
+
+def _light_bound_records(ssn, skip) -> dict:
+    """Provenance records for gangs with no pending remainder, derived
+    from the (parity-pinned) session end state — identical on both
+    paths by construction. Gangs below min_available with no pending
+    task left (fully pipelined remainders) get no record: neither
+    path's encode can see them, and skipping is the parity-safe
+    choice."""
+    out: dict = {}
+    for job in ssn.jobs.values():
+        if job.uid in skip or not _eligible(job, ssn.queues):
+            continue
+        if job.ready_task_num() >= job.min_available:
+            out[job.uid] = _bound_record(job)
+    return out
+
+
+def explain_post_solve(ssn, enc, arrays, state, result, topk: int = TOP_K) -> dict:
+    """The device path: one batched forensics kernel over every gang
+    with a pending representative row in the *pre-solve* encode (a row
+    the solve left unassigned is still pending), evaluated against the
+    final SolveState tensors. Called by xla_allocate between the gang
+    replay and dispatch so journal intents can carry the records."""
+    import numpy as np
+
+    from kube_batch_tpu.ops import explain as ops_explain
+
+    kinds = np.asarray(result.assigned_kind)
+    ready = np.asarray(result.ready_cnt)
+    a = arrays
+    job_rows: list[tuple[int, int]] = []
+    for j in range(len(enc.jobs)):
+        if not a["job_valid"][j]:
+            continue
+        js, je = int(a["job_start"][j]), int(a["job_end"][j])
+        pend = np.flatnonzero(kinds[js:je] == 0)
+        if pend.size:
+            job_rows.append((j, js + int(pend[0])))
+
+    out: dict = {}
+    if job_rows:
+        rep_rows = ops_explain.pad_rows([r for _, r in job_rows])
+        elim, feasible, would, nm_idx, nm_score, nm_planes = ops_explain.explain_batch(
+            a,
+            np.asarray(state.idle),
+            np.asarray(state.rel),
+            np.asarray(state.used),
+            np.asarray(state.ntasks),
+            np.asarray(state.nports),
+            rep_rows,
+            topk=topk,
+        )
+        valid_cnt = int(np.asarray(a["node_valid"]).sum())
+        for g, (j, rep) in enumerate(job_rows):
+            job = enc.jobs[j]
+            out[job.uid] = _forensics_record(
+                job, int(ready[j]), int(a["job_min"][j]), enc.tasks[rep],
+                enc.node_names, valid_cnt, elim[g], feasible[g], would[g],
+                nm_idx[g], nm_score[g], nm_planes[g], topk,
+            )
+    out.update(_light_bound_records(ssn, out))
+    return out
+
+
+def explain_session(ssn, topk: int = TOP_K) -> dict:
+    """The serial twin: re-encode the post-action session (node state
+    parity is exactly what the segmented-hybrid resume path already
+    relies on) and compute the identical records task-by-task with host
+    numpy. Called by the serial allocate action at the end of its
+    execute, covering both direct serial confs and every degradation
+    fallback."""
+    import numpy as np
+
+    from kube_batch_tpu.actions.xla_allocate import _nodeorder_weights
+    from kube_batch_tpu.ops import explain as ops_explain
+    from kube_batch_tpu.ops.encode import encode_session
+
+    # Mirror the device path's dtype selection so score floats agree
+    # bit-for-bit whichever path ran (f32 worlds stay f32 here).
+    try:
+        import jax.numpy as jnp
+
+        dtype = np.float64 if jnp.zeros(0).dtype == np.float64 else np.float32
+    except Exception:  # noqa: BLE001 - explain must not require jax
+        dtype = np.float64
+    # session=None: the post-action encode must not churn the
+    # cross-cycle encode cache keyed to pre-action snapshots.
+    enc = encode_session(
+        ssn.jobs, ssn.nodes, ssn.queues, dtype=dtype, pad=False, session=None
+    )
+    out: dict = {}
+    if enc.tasks:
+        a = dict(enc.arrays)
+        w_least, w_balanced, w_aff, _w_podaff = _nodeorder_weights(ssn)
+        a["w_least"] = dtype(w_least)
+        a["w_balanced"] = dtype(w_balanced)
+        a["w_aff"] = dtype(w_aff)
+        job_rows = [
+            (j, int(a["job_start"][j]))
+            for j in range(len(enc.jobs))
+            if a["job_valid"][j]
+        ]
+        elim, feasible, would, nm_idx, nm_score, nm_planes = (
+            ops_explain.explain_rows_np(
+                a, a["node_idle"], a["node_rel"], a["node_used"],
+                a["node_ntasks"], a["node_ports"],
+                [r for _, r in job_rows], topk=topk,
+            )
+        )
+        valid_cnt = int(np.asarray(a["node_valid"]).sum())
+        for g, (j, rep) in enumerate(job_rows):
+            job = enc.jobs[j]
+            out[job.uid] = _forensics_record(
+                job, int(a["job_ready0"][j]), int(a["job_min"][j]),
+                enc.tasks[rep], enc.node_names, valid_cnt, elim[g],
+                feasible[g], would[g], nm_idx[g], nm_score[g], nm_planes[g],
+                topk,
+            )
+    out.update(_light_bound_records(ssn, out))
+    return out
+
+
+# -- publication --------------------------------------------------------------
+
+
+def condition_message(rec: dict) -> str:
+    """The PodGroup condition message: kube-scheduler's one-line idiom
+    over the dense counts ("0/40 nodes feasible: 12 static, 28
+    resources; would fit if: resources")."""
+    parts = [
+        f"{rec['eliminated'][p]} {p}" for p in PLANES if rec["eliminated"].get(p)
+    ]
+    fixes = [p for p in PLANES if rec["would_fit_if"].get(p)]
+    msg = (
+        f"{rec['feasible']}/{rec['nodes']} nodes feasible for task "
+        f"{rec['task']} ({rec['ready']}/{rec['min']} ready)"
+    )
+    if parts:
+        msg += ": " + ", ".join(parts)
+    if rec["reason"] == REASON_STARVED:
+        msg += "; feasible nodes existed but the gang was starved"
+    elif fixes:
+        msg += "; would fit if: " + ", ".join(fixes)
+    return msg
+
+
+def summary(recs: dict) -> dict:
+    """Flat span-attribute summary of one cycle's records (lands on the
+    ``explain`` span, hence the flight recorder)."""
+    reasons = collections.Counter(
+        r["reason"] for r in recs.values() if r["verdict"] == "unschedulable"
+    )
+    return {
+        "gangs": len(recs),
+        "bound": sum(1 for r in recs.values() if r["verdict"] == REASON_BOUND),
+        "unschedulable": sum(reasons.values()),
+        "reasons": ",".join(f"{k}:{v}" for k, v in sorted(reasons.items())),
+    }
+
+
+def publish(ssn, recs: dict) -> None:
+    """Fan one cycle's records out: session attribute (the gang plugin
+    and journal read it), process registry (/debug/explain), reason
+    counters. Condition writes stay with the gang plugin at session
+    close so explain never fights it over the Unschedulable slot."""
+    from kube_batch_tpu import metrics
+
+    ssn.explain_records = recs
+    records.update(recs)
+    for rec in recs.values():
+        if rec["verdict"] != "unschedulable":
+            continue
+        metrics.register_unschedulable(rec["reason"])
+        if rec.get("feasible") == 0:
+            for plane, flip in rec.get("would_fit_if", {}).items():
+                if flip:
+                    metrics.register_would_fit_if(plane)
+
+
+def intent_payload(gang: str) -> dict | None:
+    """The journal-intent ``explain`` payload for one gang: the compact
+    decision label (replay ignores it; learned-scoring pipelines join
+    the full record from the registry/debug surface by gang uid)."""
+    rec = records.get(gang)
+    if rec is None:
+        return None
+    return {
+        "verdict": rec["verdict"],
+        "reason": rec["reason"],
+        "ready": rec["ready"],
+        "min": rec["min"],
+    }
+
+
+# -- aggregation (shard-local and cross-shard) --------------------------------
+
+
+def aggregate(recs) -> dict:
+    """Reason/plane histogram over an iterable of records (the
+    shard-local half of the federation story)."""
+    out = {
+        "gangs": 0,
+        "bound": 0,
+        "unschedulable": 0,
+        "reasons": collections.Counter(),
+        "would_fit_if": collections.Counter(),
+    }
+    for rec in recs:
+        out["gangs"] += 1
+        if rec["verdict"] == REASON_BOUND:
+            out["bound"] += 1
+            continue
+        out["unschedulable"] += 1
+        out["reasons"][rec["reason"]] += 1
+        if rec.get("feasible") == 0:
+            for plane, flip in rec.get("would_fit_if", {}).items():
+                if flip:
+                    out["would_fit_if"][plane] += 1
+    out["reasons"] = dict(sorted(out["reasons"].items()))
+    out["would_fit_if"] = dict(sorted(out["would_fit_if"].items()))
+    return out
+
+
+def aggregate_conditions(pod_groups) -> dict:
+    """Cross-shard aggregate over PodGroup Unschedulable conditions —
+    the one surface every shard already pushes through ``/backend/v1/``
+    into the arbiter store, so the arbiter can fold N shards' explain
+    verdicts without a new wire format. Counts the latest Unschedulable
+    condition per group whose reason is an explain reason."""
+    from kube_batch_tpu.apis.types import POD_GROUP_UNSCHEDULABLE_TYPE
+
+    known = set(PLANES) | {REASON_STARVED}
+    reasons: collections.Counter = collections.Counter()
+    for pg in pod_groups:
+        conds = [
+            c
+            for c in getattr(pg.status, "conditions", [])
+            if c.type == POD_GROUP_UNSCHEDULABLE_TYPE and c.status == "True"
+        ]
+        if conds and conds[-1].reason in known:
+            reasons[conds[-1].reason] += 1
+    return {"unschedulable": sum(reasons.values()), "reasons": dict(sorted(reasons.items()))}
+
+
+def debug_payload(gang: str | None = None) -> dict:
+    """The /debug/explain response body. ``gang`` filters by uid,
+    PodGroup name, or namespace/name."""
+    recs = records.snapshot()
+    if gang:
+        recs = [
+            r
+            for r in recs
+            if gang in (r["gang"], r["name"], r["name"].split("/", 1)[-1])
+        ]
+    return {
+        "enabled": _enabled,
+        "records": recs,
+        "aggregate": aggregate(recs),
+    }
+
+
+# -- seeded self-check --------------------------------------------------------
+
+
+def _smoke_world():
+    """One forced-unschedulable gang per feasibility plane class, plus a
+    bindable gang, on zone-partitioned nodes (node_selector confines
+    each gang to its zone so the designed plane is the only obstacle):
+
+    - ``g-static``: selector matches no zone -> static elimination;
+    - ``g-resources``: wants 64 CPU on 16-CPU nodes -> resources;
+    - ``g-ports``: wants host port 8080, zone-c residents hold it -> ports;
+    - ``g-room``: zone-d nodes have zero pod headroom left -> room;
+    - ``g-bound``: fits zone-e -> bound provenance record.
+    """
+    from kube_batch_tpu.testing import (
+        build_cluster,
+        build_node,
+        build_pod,
+        build_pod_group,
+        build_queue,
+        build_resource_list,
+    )
+    from kube_batch_tpu.apis.types import PodPhase
+
+    nodes, pods, groups = [], [], []
+    for zone in ("a", "b", "c", "d", "e"):
+        for i in range(2):
+            alloc = build_resource_list(cpu="16", memory="32Gi", pods="8")
+            if zone == "d":
+                alloc = build_resource_list(cpu="16", memory="32Gi", pods="1")
+            nodes.append(build_node(f"n-{zone}-{i}", alloc, labels={"zone": zone}))
+
+    def gang(name, zone, cpu="1", members=2, ports=None):
+        groups.append(build_pod_group(name, min_member=members))
+        for m in range(members):
+            p = build_pod(
+                name=f"{name}-{m}",
+                req=build_resource_list(cpu=cpu, memory="1Gi"),
+                group_name=name,
+                node_selector={"zone": zone},
+            )
+            if ports:
+                p.containers[0].ports = list(ports)
+            pods.append(p)
+
+    gang("g-static", "nowhere")
+    gang("g-resources", "b", cpu="64")
+    gang("g-ports", "c", ports=[8080])
+    gang("g-room", "d")
+    gang("g-bound", "e")
+    # residents: port-8080 daemons on zone-c nodes, headroom-eaters on
+    # zone-d (pods capacity 1, one resident -> zero room)
+    for i in range(2):
+        for zone, port in (("c", 8080), ("d", None)):
+            p = build_pod(
+                name=f"daemon-{zone}-{i}",
+                node_name=f"n-{zone}-{i}",
+                phase=PodPhase.RUNNING,
+                req=build_resource_list(cpu="1", memory="1Gi"),
+            )
+            if port:
+                p.containers[0].ports = [port]
+            pods.append(p)
+    return build_cluster(pods, nodes, groups, [build_queue("default")])
+
+
+_SMOKE_TIERS = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _smoke_run(action) -> tuple[dict, dict]:
+    """Open a session over a fresh smoke world, run ``action``, return
+    (records, ssn job uid -> condition reason after close)."""
+    from kube_batch_tpu.conf import parse_scheduler_conf
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.testing import FakeCache
+
+    tiers = parse_scheduler_conf(_SMOKE_TIERS).tiers
+    cache = FakeCache(_smoke_world())
+    ssn = open_session(cache, tiers)
+    try:
+        action.execute(ssn)
+    finally:
+        jobs = dict(ssn.jobs)  # close_session clears ssn.jobs
+        close_session(ssn)
+    recs = dict(getattr(ssn, "explain_records", {}) or {})
+    conds = {}
+    for uid, job in jobs.items():
+        if job.pod_group is not None and job.pod_group.status.conditions:
+            conds[uid] = job.pod_group.status.conditions[-1].reason
+    return recs, conds
+
+
+def smoke(out_dir: str | None = None) -> dict:
+    """The seeded explain self-check (``python -m
+    kube_batch_tpu.obs.explain --json``, hack/verify.py gate, Dockerfile
+    build): serial and XLA runs over the per-plane world must produce
+    byte-identical records, every designed gang must carry its designed
+    reason with a consistent would-fit-if verdict, and the forensics
+    must ride the flight recorder as an ``explain`` span."""
+    import tempfile
+
+    from kube_batch_tpu import obs
+    from kube_batch_tpu.actions.allocate import AllocateAction
+    from kube_batch_tpu.actions.xla_allocate import XlaAllocateAction
+
+    saved = {}
+    for env, value in (
+        (ENV, "1"),
+        (obs.ENV, "1"),
+        ("KBT_MIN_DEVICE_PAIRS", "0"),
+    ):
+        saved[env] = os.environ.get(env)
+        os.environ[env] = value
+    configure()
+    obs.configure()
+    obs.recorder.clear()
+    records.clear()
+    try:
+        serial_recs, serial_conds = _smoke_run(AllocateAction())
+        xla_recs, xla_conds = _smoke_run(XlaAllocateAction())
+    finally:
+        for env, value in saved.items():
+            if value is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = value
+        configure()
+        obs.configure()
+
+    def canon(recs):
+        return json.dumps(recs, sort_keys=True)
+
+    parity = canon(serial_recs) == canon(xla_recs)
+    expected = {
+        "default/g-static": "static",
+        "default/g-resources": "resources",
+        "default/g-ports": "ports",
+        "default/g-room": "room",
+        "default/g-bound": REASON_BOUND,
+    }
+    reasons = {uid: rec["reason"] for uid, rec in xla_recs.items()}
+    reasons_ok = all(reasons.get(uid) == want for uid, want in expected.items())
+    would_ok = all(
+        xla_recs[uid]["feasible"] == 0 and xla_recs[uid]["would_fit_if"][plane]
+        for uid, plane in expected.items()
+        if plane in PLANES and uid in xla_recs
+    )
+    conds_ok = all(
+        serial_conds.get(uid) == want and xla_conds.get(uid) == want
+        for uid, want in expected.items()
+        if want != REASON_BOUND
+    )
+    spans = obs.recorder.spans()
+    explain_spans = [s for s in spans if s["name"] == "explain"]
+    recorded = any(s["attrs"].get("unschedulable", 0) > 0 for s in explain_spans)
+
+    out_dir = out_dir or os.path.join(tempfile.gettempdir(), "kbt-explain-smoke")
+    os.makedirs(out_dir, exist_ok=True)
+    dump = os.path.join(out_dir, "explain.json")
+    with open(dump, "w", encoding="utf-8") as f:
+        json.dump({"serial": serial_recs, "xla": xla_recs}, f, sort_keys=True, indent=1)
+
+    result = {
+        "gangs": len(xla_recs),
+        "parity": parity,
+        "reasons": dict(sorted(reasons.items())),
+        "reasons_ok": reasons_ok,
+        "would_fit_if_ok": would_ok,
+        "conditions_ok": conds_ok,
+        "explain_spans": len(explain_spans),
+        "recorded": recorded,
+        "aggregate": aggregate(xla_recs.values()),
+        "dump": dump,
+        "ok": bool(
+            parity and reasons_ok and would_ok and conds_ok and recorded
+        ),
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="explain smoke: one forced-unschedulable gang per "
+        "feasibility plane, serial/XLA record parity asserted"
+    )
+    parser.add_argument("--out", default=None, help="record dump directory")
+    parser.add_argument(
+        "--json", action="store_true", help="print the result dict as JSON"
+    )
+    args = parser.parse_args(argv)
+    result = smoke(out_dir=args.out)
+    if args.json:
+        print(json.dumps(result, sort_keys=True, default=str))
+    else:
+        status = "ok" if result["ok"] else "FAILED"
+        print(
+            f"explain smoke: {status} ({result['gangs']} gangs, "
+            f"parity={result['parity']}, reasons={result['reasons']})"
+        )
+    return 0 if result["ok"] else 1
+
+
+configure()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

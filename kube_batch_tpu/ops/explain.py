@@ -1,0 +1,242 @@
+"""Batched unschedulability forensics over the post-solve arena tensors.
+
+The solve kernel (ops/kernels.py) already materializes, for every task it
+pops, the four feasibility planes of the serial predicate scan —
+
+- ``static``:   label-compat gather x taints/cordon (``node_ok & compat``)
+- ``room``:     pod-count headroom (``ntasks < node_max_tasks``)
+- ``ports``:    dynamic host-port bitmask disjointness
+- ``resources``: epsilon-tolerant fit against idle OR releasing, with the
+  Go nil-scalar-map parity bits (resource_info.go:255-278)
+
+— but discards them after the argmax. This module re-evaluates exactly
+those planes *after* the solve, against the final node state, for one
+representative task per still-pending gang (the first unassigned row in
+pop order — the task the serial loop abandoned on), and reduces them to
+the three answers an operator asks for:
+
+(a) per-plane node elimination counts — the dense-tensor analogue of
+    kube-scheduler's "0/40k nodes: 12k insufficient-cpu, 28k affinity";
+(b) top-k near-miss nodes by the solver's own score with per-plane
+    feasibility bits (which constraint each almost-fit node fails);
+(c) leave-one-plane-out would-fit-if verdicts: does relaxing a single
+    plane make at least one node feasible?
+
+Everything is one jitted vmap over the (padded) representative rows, so
+marginal cost is a few [N] reductions per pending gang per cycle. The
+numpy twin (`explain_rows_np`) computes the identical numbers task by
+task with correctly-rounded host arithmetic, pinning explain parity
+serial = XLA = mesh the same way the solver pins placement parity.
+
+Scores deliberately omit the InterPodAffinity term (``pod_sc``): it is
+the one score input recomputed host-side per segmented step, so the
+pre-solve matrix the device holds and the post-action matrix a serial
+re-encode sees can legitimately differ. The static affinity term
+(``aff_sc``) is per (task-group, node-group) and identical across
+encodes of the same world, so it stays in the ranking.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kube_batch_tpu.ops.kernels import MAX_PRIORITY, _le_eps, ieee_div
+
+# Fixed plane order: elimination counts, would-fit-if verdicts and
+# near-miss bit vectors are all indexed by this tuple, and the dominant
+# reason tie-break is first-plane-wins over it.
+PLANES = ("static", "room", "ports", "resources")
+
+# Keys of the encode arrays the forensics kernel reads (a strict subset
+# of the solver's inputs — nothing here mutates or extends the arena).
+ARRAY_KEYS = (
+    "task_req",
+    "task_res",
+    "task_gid",
+    "task_has_sc",
+    "task_ports",
+    "node_ok",
+    "node_valid",
+    "node_gid",
+    "node_max_tasks",
+    "node_alloc",
+    "node_idle_has_sc",
+    "node_rel_has_sc",
+    "compat",
+    "aff_sc",
+    "eps",
+)
+
+
+def pad_rows(rows: list[int], floor: int = 8) -> np.ndarray:
+    """Pad a representative-row list to the next power-of-two bucket with
+    -1 sentinels so the jitted program recompiles per world shape, not
+    per pending-gang count (same bucketing discipline as ops/encode)."""
+    n = max(len(rows), 1)
+    cap = floor
+    while cap < n:
+        cap *= 2
+    out = np.full(cap, -1, np.int32)
+    out[: len(rows)] = rows
+    return out
+
+
+def _score_planes(a, idle, rel, used, ntasks, nports, t, xp):
+    """The shared plane + score math for one representative task row.
+
+    ``xp`` is jnp on the batched device path and np on the serial twin;
+    every divide goes through ieee_div on device (correctly rounded, see
+    kernels.ieee_div) and the native / operator on host, which numpy
+    already rounds correctly — the same parity contract the solver's
+    score path relies on."""
+    fdtype = a["task_req"].dtype
+    req = a["task_req"][t]
+    if xp is jnp:
+        fits_idle = _le_eps(req, idle, a["eps"])
+        fits_rel = _le_eps(req, rel, a["eps"])
+        div = ieee_div
+    else:
+        fits_idle = np.all(req[None, :] < idle + a["eps"][None, :], axis=1)
+        fits_rel = np.all(req[None, :] < rel + a["eps"][None, :], axis=1)
+
+        def div(x, y):
+            return x / y
+
+    has_sc = a["task_has_sc"][t]
+    fits_idle = fits_idle & ~(has_sc & ~a["node_idle_has_sc"])
+    fits_rel = fits_rel & ~(has_sc & ~a["node_rel_has_sc"])
+    resources = fits_idle | fits_rel
+    static_ok = a["node_ok"] & a["compat"][a["task_gid"][t], a["node_gid"]]
+    room = ntasks < a["node_max_tasks"]
+    ports = ~xp.any(a["task_ports"][t][None, :] & nports, axis=1)
+    planes = xp.stack([static_ok, room, ports, resources])  # [4, N]
+
+    # Score: the solver's LeastRequested + BalancedResourceAllocation +
+    # static-affinity formula verbatim (kernels.body HOT LOOP #2), minus
+    # the pod_sc term — see the module docstring.
+    res = a["task_res"][t]
+    req_cpu = used[:, 0] + res[0]
+    req_mem = used[:, 1] + res[1]
+    cap_cpu = a["node_alloc"][:, 0]
+    cap_mem = a["node_alloc"][:, 1]
+
+    def least_dim(rq, cp):
+        safe = xp.where(cp == 0, 1.0, cp)
+        sc = xp.floor(div((cp - rq) * MAX_PRIORITY, safe)).astype(xp.int32)
+        return xp.where((cp == 0) | (rq > cp), 0, sc)
+
+    least = (least_dim(req_cpu, cap_cpu) + least_dim(req_mem, cap_mem)) // 2
+    cpu_f = xp.where(
+        cap_cpu != 0, div(req_cpu, xp.where(cap_cpu == 0, 1.0, cap_cpu)), 1.0
+    )
+    mem_f = xp.where(
+        cap_mem != 0, div(req_mem, xp.where(cap_mem == 0, 1.0, cap_mem)), 1.0
+    )
+    balanced = xp.where(
+        (cpu_f >= 1.0) | (mem_f >= 1.0),
+        0,
+        (MAX_PRIORITY - xp.abs(cpu_f - mem_f) * MAX_PRIORITY).astype(xp.int32),
+    )
+    score = (
+        least.astype(fdtype) * xp.asarray(a["w_least"], fdtype)
+        + balanced.astype(fdtype) * xp.asarray(a["w_balanced"], fdtype)
+        + a["aff_sc"][a["task_gid"][t], a["node_gid"]].astype(fdtype)
+        * xp.asarray(a["w_aff"], fdtype)
+    )
+    return planes, score
+
+
+@partial(jax.jit, static_argnames=("topk",))
+def _explain_jit(a, idle, rel, used, ntasks, nports, rep_rows, topk):
+    valid = a["node_valid"]
+    T = a["task_req"].shape[0]
+
+    def one(t):
+        tc = jnp.clip(jnp.maximum(t, 0), 0, T - 1)
+        planes, score = _score_planes(a, idle, rel, used, ntasks, nports, tc, jnp)
+        elim = jnp.sum(valid[None, :] & ~planes, axis=1).astype(jnp.int32)
+        feasible = jnp.sum(valid & jnp.all(planes, axis=0)).astype(jnp.int32)
+        would = jnp.stack(
+            [
+                jnp.any(valid & jnp.all(planes.at[p].set(True), axis=0))
+                for p in range(len(PLANES))
+            ]
+        )
+        # Deterministic top-k: k argmax+mask rounds, first index wins
+        # ties — byte-identical to the numpy twin's loop (lax.top_k's
+        # tie contract is not worth pinning a parity surface to).
+        ranked = jnp.where(valid, score, -jnp.inf)
+        idxs = []
+        vals = []
+        for _ in range(topk):
+            i = jnp.argmax(ranked).astype(jnp.int32)
+            idxs.append(i)
+            vals.append(score[i])
+            ranked = ranked.at[i].set(-jnp.inf)
+        nm_idx = jnp.stack(idxs)
+        nm_score = jnp.stack(vals)
+        nm_planes = planes[:, nm_idx].T  # [k, 4]
+        return elim, feasible, would, nm_idx, nm_score, nm_planes
+
+    return jax.vmap(one)(rep_rows)
+
+
+def explain_batch(a, idle, rel, used, ntasks, nports, rep_rows, topk=3):
+    """Batched device forensics over padded representative rows.
+
+    ``a`` is the solver's arrays dict (host or device residency — any
+    mix works, jit transfers what it needs); the five state tensors are
+    the *final* SolveState fields. Returns host numpy arrays
+    ``(elim [G,4], feasible [G], would_fit [G,4], nm_idx [G,k],
+    nm_score [G,k], nm_planes [G,k,4])``; rows where ``rep_rows`` is -1
+    are padding and carry garbage the caller must mask."""
+    sub = {k: a[k] for k in ARRAY_KEYS}
+    for w in ("w_least", "w_balanced", "w_aff"):
+        sub[w] = jnp.asarray(a[w], a["task_req"].dtype)
+    out = _explain_jit(
+        sub,
+        jnp.asarray(idle),
+        jnp.asarray(rel),
+        jnp.asarray(used),
+        jnp.asarray(ntasks),
+        jnp.asarray(nports),
+        jnp.asarray(rep_rows, jnp.int32),
+        topk=int(topk),
+    )
+    return tuple(np.asarray(x) for x in out)
+
+
+def explain_rows_np(a, idle, rel, used, ntasks, nports, rep_rows, topk=3):
+    """The serial twin: identical numbers, computed task by task with
+    host numpy (the correctness-oracle side of explain parity)."""
+    valid = np.asarray(a["node_valid"], bool)
+    G = len(rep_rows)
+    k = int(topk)
+    elim = np.zeros((G, len(PLANES)), np.int32)
+    feasible = np.zeros(G, np.int32)
+    would = np.zeros((G, len(PLANES)), bool)
+    nm_idx = np.zeros((G, k), np.int32)
+    nm_score = np.zeros((G, k), np.float64)
+    nm_planes = np.zeros((G, k, len(PLANES)), bool)
+    for g, t in enumerate(rep_rows):
+        if t < 0:
+            continue
+        planes, score = _score_planes(a, idle, rel, used, ntasks, nports, int(t), np)
+        elim[g] = np.sum(valid[None, :] & ~planes, axis=1)
+        feasible[g] = np.sum(valid & np.all(planes, axis=0))
+        for p in range(len(PLANES)):
+            relaxed = planes.copy()
+            relaxed[p] = True
+            would[g, p] = bool(np.any(valid & np.all(relaxed, axis=0)))
+        ranked = np.where(valid, score, -np.inf)
+        for j in range(k):
+            i = int(np.argmax(ranked))
+            nm_idx[g, j] = i
+            nm_score[g, j] = score[i]
+            nm_planes[g, j] = planes[:, i]
+            ranked[i] = -np.inf
+    return elim, feasible, would, nm_idx, nm_score, nm_planes

@@ -80,6 +80,7 @@ class GangPlugin(Plugin):
     def on_session_close(self, ssn: Session) -> None:
         """Emit Unschedulable conditions + metrics for non-ready jobs
         (gang.go:132-162)."""
+        explain_records = getattr(ssn, "explain_records", {}) or {}
         unschedulable_jobs = 0
         for job in ssn.jobs.values():
             if not job.ready():
@@ -88,6 +89,19 @@ class GangPlugin(Plugin):
                     f"{unready}/{len(job.tasks)} tasks in gang unschedulable: "
                     f"{job.fit_error()}"
                 )
+                reason = NOT_ENOUGH_RESOURCES_REASON
+                # Forensics enrichment (obs/explain): when the allocate
+                # action published a record for this gang, the condition
+                # carries the dominant plane as its reason and the
+                # elimination/would-fit-if breakdown as its message —
+                # this is also the cross-shard channel, since conditions
+                # ride /backend/v1/ commits into the arbiter store.
+                rec = explain_records.get(job.uid)
+                if rec is not None and rec.get("verdict") != "bound":
+                    from kube_batch_tpu.obs import explain as _explain
+
+                    reason = rec["reason"]
+                    msg = _explain.condition_message(rec)
                 unschedulable_jobs += 1
                 metrics.update_unschedule_task_count(job.name, unready)
                 metrics.register_job_retries(job.name)
@@ -99,7 +113,7 @@ class GangPlugin(Plugin):
                             status="True",
                             transition_id=ssn.uid,
                             last_transition_time=time.time(),
-                            reason=NOT_ENOUGH_RESOURCES_REASON,
+                            reason=reason,
                             message=msg,
                         ),
                     )

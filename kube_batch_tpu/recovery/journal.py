@@ -108,6 +108,7 @@ class WriteIntentJournal:
         entries: list[tuple[str, str, str]],
         cycle: int = 0,
         trace: str = "",
+        explain: dict | None = None,
     ) -> list[int]:
         """Append one ``intent`` record per (gang, pod_key, node) entry
         as a single flushed write; returns the assigned seqs (parallel
@@ -116,9 +117,12 @@ class WriteIntentJournal:
 
         ``trace`` is the dispatching cycle's trace id (kube_batch_tpu.obs);
         when set it rides each intent record so a takeover post-mortem
-        can join the journal against a flight-recorder dump. ``replay``
-        ignores unknown keys, so old journals and traceless writers stay
-        fully compatible."""
+        can join the journal against a flight-recorder dump. ``explain``
+        maps gang uid -> compact forensics payload (obs.explain
+        intent_payload); when the dispatching gang has one it rides the
+        intent record, giving the journal labeled (state, decision,
+        reason) tuples. ``replay`` ignores unknown keys, so old journals
+        and traceless/explainless writers stay fully compatible."""
         if not entries:
             return []
         if faults.should_fire("journal.append"):
@@ -143,6 +147,8 @@ class WriteIntentJournal:
                 }
                 if trace:
                     rec["trace"] = trace
+                if explain and gang in explain:
+                    rec["explain"] = explain[gang]
                 lines.append(json.dumps(rec, separators=(",", ":")))
             self._write("\n".join(lines) + "\n")
         metrics.register_journal_records("intent", len(entries))

@@ -30,6 +30,7 @@ import kube_batch_tpu.actions  # noqa: F401  (registers the action pipeline)
 import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
 from kube_batch_tpu import faults, log, metrics, obs
 from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.obs import explain as _obs_explain
 from kube_batch_tpu.conf import (
     load_scheduler_conf,
     parse_scheduler_conf,
@@ -109,6 +110,7 @@ class Scheduler:
         # node table through it.
         self._conf_streaming = False
         self._conf_trace = ""
+        self._conf_explain = ""
         self._stream_state = None
         self._stream_trigger = None
         self.micro_cycles_run = 0
@@ -130,9 +132,10 @@ class Scheduler:
                 )
                 conf_str = self._conf_cache or DEFAULT_SCHEDULER_CONF
         if conf_str == self._conf_cache:
-            # env flips (KBT_TRACE) still apply between conf pushes; the
-            # conf `trace:` value, when set, wins (obs.configure)
+            # env flips (KBT_TRACE/KBT_EXPLAIN) still apply between conf
+            # pushes; the conf value, when set, wins
             obs.configure(self._conf_trace)
+            _obs_explain.configure(self._conf_explain)
             return
         try:
             self.actions, self.plugins, self.action_arguments = load_scheduler_conf(
@@ -143,6 +146,8 @@ class Scheduler:
             self._conf_streaming = parsed.streaming
             self._conf_trace = parsed.trace
             obs.configure(parsed.trace)
+            self._conf_explain = parsed.explain
+            _obs_explain.configure(parsed.explain)
             # Conf-driven fault drills (the `faults:` key, same grammar as
             # KBT_FAULTS): armed only when the conf actually changed, so a
             # drill's fire counts are not re-armed every cycle.

@@ -15,6 +15,9 @@ exposes:
   ``?dump=1`` also writes the JSONL + Chrome trace files);
 - ``GET /debug/slo`` — per-queue time-to-bind / queue-wait quantiles
   (kube_batch_tpu/obs SLO accountant);
+- ``GET /debug/explain`` — per-gang unschedulability forensics records
+  and cross-gang aggregate (kube_batch_tpu/obs/explain; ``?gang=ns/name``
+  filters to one gang);
 - ``GET|POST /apis/v1alpha1/queues`` and
   ``DELETE /apis/v1alpha1/queues/<name>`` — the queue CRD surface the
   reference CLI talks to (pkg/cli/queue);
@@ -567,6 +570,15 @@ def _make_handler(server: "SchedulerServer"):
                 self._reply(200, json.dumps(payload))
             elif path == "/debug/slo":
                 self._reply(200, json.dumps(obs.slo.snapshot()))
+            elif path == "/debug/explain":
+                # Unschedulability forensics registry (obs/explain):
+                # per-gang reason records + cross-gang aggregate;
+                # ``?gang=ns/name`` filters to one gang.
+                from kube_batch_tpu.obs import explain as obs_explain
+
+                query = urllib.parse.parse_qs(parsed.query)
+                gang = query.get("gang", [""])[0] or None
+                self._reply(200, json.dumps(obs_explain.debug_payload(gang)))
             elif path == "/backend/v1/version":
                 # Store-backend protocol (cache/backend.py): the store
                 # version optimistic writes are checked against.

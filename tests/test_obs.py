@@ -472,6 +472,43 @@ def test_metrics_exposition_matches_golden_file():
         assert text == fh.read()
 
 
+def test_every_registered_family_exposes_help_and_type():
+    """The real exposition (not the synthetic golden families) must
+    carry a # HELP and # TYPE pair for every family — including the
+    forensics counters — so scrapers never see an undocumented series.
+    The KBT-R011 analyzer enforces the declaration side statically;
+    this pins the rendered text."""
+    metrics.register_unschedulable("ports")
+    metrics.register_would_fit_if("ports")
+    text = metrics.render_prometheus_text()
+    helps = {
+        line.split()[2] for line in text.splitlines()
+        if line.startswith("# HELP ")
+    }
+    types = {
+        line.split()[2] for line in text.splitlines()
+        if line.startswith("# TYPE ")
+    }
+    assert helps == types and helps
+    for name in ("kube_batch_tpu_unschedulable_total",
+                 "kube_batch_tpu_would_fit_if_total"):
+        assert name in helps, f"{name} missing from exposition"
+    # every sample line belongs to a family that announced itself
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        bare = line.split("{")[0].split()[0]
+        # histogram samples ride _bucket/_sum/_count suffixes; a family
+        # may itself END in one of those (unschedule_task_count), so
+        # accept the bare name first and the stripped root second
+        candidates = {bare} | {
+            bare[: -len(s)]
+            for s in ("_bucket", "_sum", "_count")
+            if bare.endswith(s)
+        }
+        assert candidates & helps, f"sample {bare} has no # HELP"
+
+
 def test_histogram_inf_bucket_equals_count_per_label_set():
     h, _, _ = _golden_families()
     rendered = "\n".join(metrics._render_family(h))
@@ -539,11 +576,11 @@ def test_conf_trace_key_hot_reloads_the_switch(tmp_path):
 
 
 def test_span_names_registry_matches_reality():
-    """Every name the tree checker accepts is declared, and the two
+    """Every name the tree checker accepts is declared, and the three
     debug endpoints are exactly the declared surface (the KBT-R analyzer
     enforces the call-site side; this pins the registry's shape)."""
     assert len(obs.SPAN_NAMES) == len(set(obs.SPAN_NAMES))
-    assert obs.DEBUG_ENDPOINTS == ("/debug/trace", "/debug/slo")
+    assert obs.DEBUG_ENDPOINTS == ("/debug/trace", "/debug/slo", "/debug/explain")
     bad = obs.check_tree([{
         "name": "not-a-span", "trace_id": "t", "span_id": "s",
         "parent_id": "missing",
