@@ -37,7 +37,11 @@ Runs, in order:
    through a real scheduling path, asserting binds still land;
 7. the encode-cache parity smoke (python -m kube_batch_tpu.ops.encode_cache):
    warm and 1%-node-churn encodes must be byte-identical to a fresh
-   cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on);
+   cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on),
+   then the pipelined-cycle parity smoke (same module, ``--pipeline``):
+   one seeded world scheduled with KBT_PIPELINE off and on must bind
+   pod-for-pod identically, with the pipelined run's dispatch deferred
+   through the fence and the arena ping-ponging its device banks;
 8. the streaming smoke (python -m kube_batch_tpu.streaming --json):
    event-driven micro-cycles must bind every arrival AND place it on
    the same node a pure full-cycle twin picks (parity), with at least
@@ -58,7 +62,10 @@ Runs, in order:
 With ``--bench-diff OLD NEW``, two bench artifacts (fresh bench.py
 output or archived BENCH_*.json wrappers) are regression-gated via
 hack/bench_diff.py --strict: >15% p50 regressions, parity flips,
-compile-budget changes and vanished rows all fail the gate.
+compile-budget changes and vanished rows all fail the gate. With
+``--bench-diff`` and no paths, the two newest ``BENCH_*.json`` in the
+repo root are auto-discovered (mtime order, name as tie-break) and
+diffed oldest-of-the-pair -> newest.
 
 With ``--chaos``, two more gates run: the chaos-marked pytest subset
 (tests/test_faults.py + tests/test_recovery.py + tests/test_federation.py
@@ -77,7 +84,7 @@ leave store truth fsck-clean.
 Exit 0 iff every gate is clean.
 Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
                               [--obs] [--interleave] [--json]
-                              [--bench-diff OLD.json NEW.json]
+                              [--bench-diff [OLD.json NEW.json]]
 
 ``--json`` appends one machine-readable summary line to stdout
 (per-gate pass/fail + finding counts) so bench/CI can record the
@@ -654,12 +661,29 @@ def main(argv: list[str] | None = None) -> int:
     bench_diff: tuple[str, str] | None = None
     if "--bench-diff" in argv:
         i = argv.index("--bench-diff")
-        if len(argv) < i + 3 or argv[i + 1].startswith("--") \
-                or argv[i + 2].startswith("--"):
-            print("verify: --bench-diff takes two bench JSON paths (OLD NEW)")
+        paths = [a for a in argv[i + 1:i + 3] if not a.startswith("--")]
+        if len(paths) == 1:
+            print("verify: --bench-diff takes two bench JSON paths (OLD NEW) "
+                  "or none, to auto-discover the two newest BENCH_*.json")
             return 2
-        bench_diff = (argv[i + 1], argv[i + 2])
-        argv = argv[:i] + argv[i + 3:]
+        if not paths:
+            import glob
+
+            found = sorted(
+                glob.glob(os.path.join(REPO, "BENCH_*.json")),
+                key=lambda p: (os.path.getmtime(p), p),
+            )
+            if len(found) < 2:
+                print("verify: --bench-diff auto-discovery needs at least "
+                      "two BENCH_*.json artifacts in the repo root")
+                return 2
+            bench_diff = (found[-2], found[-1])
+            print("verify: bench-diff auto-discovered "
+                  f"{os.path.basename(found[-2])} -> "
+                  f"{os.path.basename(found[-1])}")
+        else:
+            bench_diff = (paths[0], paths[1])
+        argv = argv[:i] + argv[i + 1 + len(paths):]
     unknown = [
         a for a in argv
         if a not in ("--strict", "--chaos", "--json", "--interleave",
@@ -787,6 +811,25 @@ def main(argv: list[str] | None = None) -> int:
     gates["encode_cache_smoke"] = {"ok": res.returncode == 0}
     if res.returncode != 0:
         print("verify: encode-cache parity smoke FAILED")
+        failed = True
+
+    # 7a. pipelined-cycle parity smoke: the same seeded world scheduled
+    # with KBT_PIPELINE off then on must bind pod-for-pod identically,
+    # with the pipelined run's dispatch actually deferred through the
+    # fence and the arena ping-ponging its device banks
+    # (python -m kube_batch_tpu.ops.encode_cache --pipeline). Pipeline
+    # overrides armed in the shell must not skew either half.
+    env_pl = dict(env_ec)
+    for var in ("KBT_PIPELINE", "KBT_PIPELINE_FENCE_TIMEOUT_S",
+                "KBT_EXCHANGE_BATCH"):
+        env_pl.pop(var, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.ops.encode_cache", "--pipeline"],
+        cwd=REPO, env=env_pl,
+    )
+    gates["pipeline_smoke"] = {"ok": res.returncode == 0}
+    if res.returncode != 0:
+        print("verify: pipelined-cycle parity smoke FAILED")
         failed = True
 
     # 7b. streaming smoke: micro-cycles bind every arrival and agree

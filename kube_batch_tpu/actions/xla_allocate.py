@@ -125,6 +125,10 @@ class XlaAllocateAction(Action):
         # "sharded_xla", "pallas", "xla", "serial"); bench rows assert on
         # this so a silent downgrade cannot masquerade as evidence.
         self.last_solver_tier = "none"
+        # Gang iterations the last execute() committed from K-deep
+        # batched mesh exchanges (KBT_EXCHANGE_BATCH; 0 off the batched
+        # program). Bench rows read this as amortization evidence.
+        self.last_batched_iters = 0
         # Whether the last FULL-cycle encode saw any pod-affinity terms
         # (pending or resident). Streaming micro-cycles pass this as the
         # resident_interpod hint so the encode skips the O(resident-pods)
@@ -143,6 +147,7 @@ class XlaAllocateAction(Action):
 
         self.last_timings = {}  # never report a previous cycle's path
         self.last_solver_tier = "none"
+        self.last_batched_iters = 0
         if not _kernel_supported(ssn):
             log.info("conf outside kernel envelope; running serial allocate")
             self._fallback(ssn)
@@ -363,39 +368,75 @@ class XlaAllocateAction(Action):
             self.last_timings = {"serial_degraded_s": _time.perf_counter() - t0}
             return
         t_solve = _time.perf_counter() - t0
-        t0 = _time.perf_counter()
-        t_explain = 0.0
-        with obs.span("gang.assign", assigned=int(result.n_assigned)):
-            replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
-            if budget is not None:
-                # The last pre-dispatch gate: past this point binds reach
-                # the cache and the cycle can no longer abort cleanly. The
-                # cycle.overrun drill injects here (inject=True) — maximal
-                # discardable work, zero cache mutation.
-                budget.check("dispatch barrier", inject=True)
-            # Post-solve forensics (obs/explain): batched plane/score
-            # reductions against the FINAL solver state, published before
-            # replay.finish so the journal intents it writes can attach
-            # per-gang reason payloads — and after the budget gate, so an
-            # aborted cycle leaves no half-cycle records behind.
-            from kube_batch_tpu.obs import explain as _explain
 
-            if _explain.enabled():
-                te = _time.perf_counter()
-                with obs.span("explain", micro=micro) as xsp:
-                    recs = _explain.explain_post_solve(ssn, enc, arrays, state, result)
-                    _explain.publish(ssn, recs)
-                    for k, v in _explain.summary(recs).items():
-                        xsp.set_attr(k, v)
-                t_explain = _time.perf_counter() - te
-            replay.finish(np.asarray(result.ready_cnt))
-        self.last_timings = {
-            "encode_s": t_encode,
-            "solve_s": t_solve,
-            "replay_s": _time.perf_counter() - t0 - t_explain,
-        }
-        if t_explain:
-            self.last_timings["explain_s"] = t_explain
+        # Pipelined cycles (kube_batch_tpu.pipeline, KBT_PIPELINE): the
+        # post-solve phase — statement replay, forensics, dispatch — is
+        # pure host/cache work that needs nothing further from the
+        # device, so it can ride the cache's kb-write pool while the
+        # next cycle encodes and solves. The dispatch fence keeps the
+        # ordering the synchronous path gets for free (dispatch N <
+        # snapshot N+1), close_session joins before the commit
+        # write-back, and micro-cycles never defer (their outcome
+        # accounting reads the session synchronously).
+        from kube_batch_tpu import pipeline as _pipeline
+
+        defer = _pipeline.enabled() and not micro
+        if defer and budget is not None:
+            # The last pre-dispatch gate must stay on the scheduling
+            # thread so a deadline abort (and the cycle.overrun drill's
+            # inject=True) still unwinds through run_once's discard path
+            # with zero cache mutation.
+            budget.check("dispatch barrier", inject=True)
+
+        timings: dict[str, float] = {"encode_s": t_encode, "solve_s": t_solve}
+        self.last_timings = timings
+
+        def _post_solve(parent=None) -> float:
+            t0 = _time.perf_counter()
+            t_explain = 0.0
+            with obs.span(
+                "gang.assign", parent=parent, assigned=int(result.n_assigned)
+            ):
+                replay.apply_upto(assign_pos, assigned_node, assigned_kind, int(result.n_assigned))
+                if not defer and budget is not None:
+                    # The last pre-dispatch gate: past this point binds reach
+                    # the cache and the cycle can no longer abort cleanly. The
+                    # cycle.overrun drill injects here (inject=True) — maximal
+                    # discardable work, zero cache mutation.
+                    budget.check("dispatch barrier", inject=True)
+                # Post-solve forensics (obs/explain): batched plane/score
+                # reductions against the FINAL solver state, published before
+                # replay.finish so the journal intents it writes can attach
+                # per-gang reason payloads — and after the budget gate, so an
+                # aborted cycle leaves no half-cycle records behind.
+                from kube_batch_tpu.obs import explain as _explain
+
+                if _explain.enabled():
+                    te = _time.perf_counter()
+                    with obs.span("explain", micro=micro) as xsp:
+                        recs = _explain.explain_post_solve(ssn, enc, arrays, state, result)
+                        _explain.publish(ssn, recs)
+                        for k, v in _explain.summary(recs).items():
+                            xsp.set_attr(k, v)
+                    t_explain = _time.perf_counter() - te
+                replay.finish(np.asarray(result.ready_cnt))
+            dur = _time.perf_counter() - t0
+            timings["replay_s"] = dur - t_explain
+            if t_explain:
+                timings["explain_s"] = t_explain
+            return dur
+
+        if defer:
+            ctx = obs.current()  # pool threads don't inherit the contextvar
+
+            def _deferred() -> None:
+                _pipeline.fence.record_dispatch_seconds(_post_solve(parent=ctx))
+
+            fut = _pipeline.submit(ssn.cache, _deferred)
+            ssn.deferred_dispatch = fut
+            _pipeline.fence.arm(fut)
+        else:
+            _post_solve()
 
     def _mesh_requested(self, ssn: Session) -> bool:
         """True when the conf/env names a mesh at all — resolution may
@@ -664,7 +705,14 @@ class XlaAllocateAction(Action):
                         try:
                             if faults.should_fire("solve.mesh_pallas"):
                                 raise faults.FaultInjected("solve.mesh_pallas")
+                            before = mp.batched_iters
                             out = mp.solve(st)
+                            gained = mp.batched_iters - before
+                            if gained:
+                                self.last_batched_iters += gained
+                                metrics.register_exchange_batched_iters(
+                                    gained
+                                )
                             ladder.record_success("mesh_pallas")
                             self.last_solver_tier = "mesh_pallas"
                             return out

@@ -44,10 +44,13 @@ counterexample's trace id is its replay seed:
 ``python -m kube_batch_tpu.analysis.interleave --replay broken_drain:011``
 re-runs exactly that schedule step by step, verbosely.
 
-The five default scenarios: ``micro_vs_full``, ``event_vs_invalidate``,
-``takeover_vs_dispatch``, ``watch410_vs_drain`` (ISSUE 9), and
+The six default scenarios: ``micro_vs_full``, ``event_vs_invalidate``,
+``takeover_vs_dispatch``, ``watch410_vs_drain`` (ISSUE 9),
 ``two_scheduler_conflict`` (ISSUE 10 — two federated schedulers racing
-optimistic gang dispatches onto one node). The intentionally broken fixture
+optimistic gang dispatches onto one node), and
+``dispatch_vs_next_solve`` (ISSUE 13 — cycle N's deferred dispatch
+racing cycle N+1's snapshot through the KBT_PIPELINE dispatch
+fence). The intentionally broken fixture
 ``broken_drain`` (a trigger whose ``drain()`` empties the backlog
 instead of copy-until-prune) is excluded from the default set; it
 exists so the seeded-counterexample loop stays demonstrably alive —
@@ -246,7 +249,12 @@ class Scenario:
 
     # -- world building (mirrors tests/test_streaming.py's harness) ----------
 
-    def _wire(self, nodes: int = 4, die_after: Optional[int] = None):
+    def _wire(
+        self,
+        nodes: int = 4,
+        die_after: Optional[int] = None,
+        conf_text: str = _CONF,
+    ):
         from kube_batch_tpu import faults
         from kube_batch_tpu.cache import ClusterStore, SchedulerCache
         from kube_batch_tpu.cache.store import PODS, EventHandler
@@ -257,7 +265,7 @@ class Scenario:
 
         conf = os.path.join(self.workdir, "conf.yaml")
         with open(conf, "w", encoding="utf-8") as fh:
-            fh.write(_CONF)
+            fh.write(conf_text)
         self.store = ClusterStore()
         self._seed(self.store, nodes)
         self.bind_counts: dict = {}
@@ -810,6 +818,89 @@ class BrokenDrain(Scenario):
         return out
 
 
+# The pipelined-cycles scenario routes allocation through xla_allocate
+# — the only action with a deferrable post-solve phase —
+# with min_device_pairs 0 so the tiny model cluster cannot be rerouted
+# to serial by the size floor (the same pin the parity suites use).
+# With the writer pool off (the harness never calls cache.run()),
+# submit_dispatch runs the deferred closure inline at submission, so
+# the fence/deferred-tail protocol executes in full while the schedule
+# stays the only source of nondeterminism.
+_CONF_PIPELINE = _CONF.replace(
+    'actions: "enqueue, allocate, backfill"',
+    'actions: "enqueue, xla_allocate, backfill"\n'
+    "actionArguments:\n"
+    "  xla_allocate:\n"
+    '    min_device_pairs: "0"',
+)
+
+
+class DispatchVsNextSolve(Scenario):
+    name = "dispatch_vs_next_solve"
+    describe = (
+        "pipelined cycles (KBT_PIPELINE): cycle N's deferred dispatch "
+        "racing cycle N+1's snapshot through the dispatch fence, with "
+        "a gang arrival + micro drain in flight — every schedule must "
+        "bind both gangs exactly once, identically, and leave the "
+        "fence clean"
+    )
+
+    def build(self) -> None:
+        from kube_batch_tpu import pipeline
+
+        self._saved_pipeline_env = os.environ.get(pipeline.ENV)
+        os.environ[pipeline.ENV] = "1"
+        pipeline.reset()
+        # One node (it fits both gangs): whichever cycle binds first,
+        # every pod lands on n0, so bind-for-bind parity holds across
+        # schedules even though g1/g2 bind order varies.
+        self._wire(nodes=1, conf_text=_CONF_PIPELINE)
+        self.sched.run_once()  # adopt the resident table
+        self._arrive(self.store, "g1", 3)  # cycle N has binds to defer
+        # Prune g1 from the trigger backlog (drain() alone copies
+        # without removing): the racing micro-cycle can then only ever
+        # serve g2, so every schedule has at least one full cycle with
+        # work to defer — without this, a micro-first schedule drains
+        # everything and the fence protocol never runs.
+        self.trigger.prune({"default/g1"})
+        self.threads = [
+            [self.s_full("full_cycle_n"), self.s_full("full_cycle_n1")],
+            [self.s_arrive("g2", 3), self.s_micro()],
+        ]
+
+    def invariants(self) -> list:
+        from kube_batch_tpu import pipeline
+
+        out = super().invariants()
+        if pipeline.fence.degraded_reason is not None:
+            out.append(
+                "pipeline degraded to synchronous during a clean "
+                f"schedule: {pipeline.fence.degraded_reason}"
+            )
+        if pipeline.fence.pending():
+            out.append(
+                "dispatch fence left armed after every cycle completed "
+                "— a deferred dispatch was never joined"
+            )
+        if pipeline.fence._dispatch_s <= 0.0:
+            out.append(
+                "model error: no cycle recorded a deferred dispatch — "
+                "the pipelined path never engaged (serial reroute?) and "
+                "the scenario checked nothing"
+            )
+        return out
+
+    def cleanup(self) -> None:
+        from kube_batch_tpu import pipeline
+
+        pipeline.reset()
+        if self._saved_pipeline_env is None:
+            os.environ.pop(pipeline.ENV, None)
+        else:
+            os.environ[pipeline.ENV] = self._saved_pipeline_env
+        super().cleanup()
+
+
 SCENARIOS = {
     c.name: c
     for c in (
@@ -818,6 +909,7 @@ SCENARIOS = {
         TakeoverVsDispatch,
         Watch410VsDrain,
         TwoSchedulerConflict,
+        DispatchVsNextSolve,
     )
 }
 FIXTURES = {BrokenDrain.name: BrokenDrain}

@@ -34,8 +34,10 @@ modes that sink a warm scheduling loop but are invisible syntactically:
 Entry points traced (mirroring ``actions/xla_allocate`` dispatch):
 ``ops.kernels`` fresh+resume (the XLA twin), ``parallel.sharded`` at
 mesh {1,2,4,8}, ``parallel.sharded_pallas`` at mesh {1,2,4,8} (jnp
-block backend — same program geometry as the mosaic one), the fused
-``ops.pallas_solve`` program, and the encode-cache arena row-scatter.
+block backend — same program geometry as the mosaic one) plus its
+K-deep batched-exchange variant at the largest mesh, the fused
+``ops.pallas_solve`` program, and the encode-cache arena row-scatter
+(donation checked for both ping-pong banks).
 
 Findings flow through the same ``Finding``/baseline machinery as the
 AST suite (own CLI: ``python -m kube_batch_tpu.analysis.trace``, own
@@ -94,6 +96,7 @@ _PATHS = {
     "xla_twin": "kube_batch_tpu/ops/kernels.py",
     "sharded": "kube_batch_tpu/parallel/sharded.py",
     "mesh_pallas": "kube_batch_tpu/parallel/sharded_pallas.py",
+    "mesh_pallas_batched": "kube_batch_tpu/parallel/sharded_pallas.py",
     "pallas_solve": "kube_batch_tpu/ops/pallas_solve.py",
     "arena_scatter": "kube_batch_tpu/ops/encode_cache.py",
 }
@@ -460,6 +463,38 @@ def run_trace_audit(
             jax.eval_shape(sp._fresh, a_avals, s_avals)
         )
 
+    # 3b. The K-deep batched-exchange program (KBT_EXCHANGE_BATCH under
+    # KBT_PIPELINE): same SPMD geometry, but the gang loop speculates K
+    # iterations per shard and ships one [K, record] all-gather per
+    # round. Audited at the largest usable mesh — the size the batching
+    # exists for. The program returns (SolveState, n_batched); the
+    # drift check pins the state element field-for-field against the
+    # twin, so the batched rung cannot fork the resume protocol.
+    mb = usable[-1]
+    spb = ShardedPallasSolver(
+        arrays, make_mesh(mb), True, True, block_impl="jnp", exchange_batch=4
+    )
+    ab_call = dict(spb.a)
+    ab_call["_tports"] = spb._tports
+    ab_avals = {
+        k: jax.ShapeDtypeStruct(np.asarray(v).shape, np.asarray(v).dtype)
+        for k, v in ab_call.items()
+    }
+    sb_avals = {
+        k: jax.ShapeDtypeStruct(np.asarray(v).shape, np.asarray(v).dtype)
+        for k, v in spb._statics.items()
+    }
+    capture(
+        f"mesh_pallas_batched@{mb}",
+        _PATHS["mesh_pallas_batched"],
+        spb._fresh,
+        ab_avals,
+        sb_avals,
+    )
+    sigs[f"mesh_pallas_batched@{mb}"] = state_signature(
+        jax.eval_shape(spb._fresh, ab_avals, sb_avals)[0]
+    )
+
     # 4. Fused single-chip Pallas program (interpret build traces the
     # same jaxpr structure the mosaic build lowers).
     from kube_batch_tpu.ops.pallas_solve import PallasSolver
@@ -485,6 +520,15 @@ def run_trace_audit(
         check_donation(scatter, (buf, idx, vals), "arena_scatter",
                        _PATHS["arena_scatter"])
     )
+    # 5b. Pipelined mode ping-pongs the same donated scatter across two
+    # live device slab sets (encode_cache bank 0/1); donation must hold
+    # with a second live buffer in flight too, or double-buffering
+    # silently copies and the arena's device footprint doubles per bank.
+    findings.extend(
+        check_donation(scatter, (buf, idx, vals), "arena_scatter.pingpong",
+                       _PATHS["arena_scatter"])
+    )
+    entries.setdefault("arena_scatter.pingpong", entries["arena_scatter"])
 
     # 6. Cross-tier signature drift vs the twin.
     for entry, sig in sigs.items():

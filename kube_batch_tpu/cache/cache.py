@@ -1286,6 +1286,25 @@ class SchedulerCache:
         else:
             fn(*args)  # run() not started (unit tests): write inline
 
+    def submit_dispatch(self, fn):
+        """Run a deferred post-solve dispatch closure on the kb-write
+        pool, returning its Future (kube_batch_tpu.pipeline rides this
+        for KBT_PIPELINE cycles). Unlike `_submit_write`, the caller
+        needs the Future: the dispatch fence joins it before the next
+        cycle's snapshot. With the pool off (run() not started), the
+        closure runs inline and the returned Future is already done —
+        the pipelined path degenerates to the synchronous one."""
+        from concurrent.futures import Future
+
+        if self._writer is not None:
+            return self._writer.submit(fn)
+        fut: Future = Future()
+        try:
+            fut.set_result(fn())
+        except BaseException as e:  # noqa: BLE001 - carried by the future
+            fut.set_exception(e)
+        return fut
+
     # -- resync + GC workers (reference cache.go:480-534) ------------------
 
     def resync_task(self, task: TaskInfo) -> None:

@@ -96,6 +96,8 @@ POINTS = (
     "encode.cache",     # cache poisoned -> state dropped, encode runs cold
     # streaming micro-cycles (scheduler.py run_micro)
     "stream.micro_cycle",  # micro-cycle solve fails -> degrade to full cycle, no pod dropped
+    # pipelined cycles (pipeline.py DispatchFence)
+    "pipeline.fence",   # deferred dispatch wedged -> fence timeout -> sync degrade
     # sharded federation (cache/store.py, cache/backend.py, federation.py)
     "store.conflict",      # conditional write rejected -> loser resyncs gang + retries
     "federation.partition",  # loopback backend transport drops -> backoff + relist heal

@@ -80,6 +80,13 @@ class Session:
         # intent writer, and the flight-recorder span summaries.
         self.explain_records: dict[str, dict] = {}
 
+        # Pipelined cycles (KBT_PIPELINE): the Future of this session's
+        # in-flight post-solve dispatch, set by xla_allocate when it
+        # defers the phase onto the kb-write pool. close_session joins
+        # it before the commit write-back; the scheduler's actions loop
+        # joins it before running a later action over the same session.
+        self.deferred_dispatch = None
+
         self.plugins: dict[str, Plugin] = {}
         self.event_handlers: list[EventHandler] = []
         self.job_order_fns: dict[str, Callable] = {}
@@ -563,6 +570,26 @@ def close_session(ssn: Session, discard: bool = False) -> None:
     skipped: the aborted cycle's session state is rolled back wholesale
     — Statement.discard at cycle granularity — leaving the cache/store
     byte-identical to the cycle's start."""
+    # Pipelined cycles (KBT_PIPELINE): a deferred post-solve dispatch
+    # must land before anything below — the plugin close hooks and the
+    # commit write-back read the session state the deferred replay
+    # mutates, and job status must describe binds that actually
+    # happened. A dispatch failure closes the session like the
+    # synchronous path would (logged, no binds beyond what landed) and
+    # degrades the pipeline loudly.
+    if getattr(ssn, "deferred_dispatch", None) is not None:
+        from kube_batch_tpu import log, pipeline
+
+        try:
+            pipeline.join_session(ssn)
+        except Exception as e:  # noqa: BLE001 - parity with sync-path logging
+            log.errorf(
+                "deferred dispatch failed while closing session %s: %s", ssn.uid, e
+            )
+            pipeline.fence.degrade(
+                f"deferred dispatch raised {type(e).__name__}: {e}"
+            )
+
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)

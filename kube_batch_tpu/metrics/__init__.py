@@ -327,6 +327,24 @@ would_fit_if_total = Counter(
     "feasible, by plane",
 )
 
+# -- pipelined cycles (kube_batch_tpu.pipeline, KBT_PIPELINE) ----------------
+pipeline_overlap_fraction = Gauge(
+    f"{_SUBSYSTEM}_pipeline_overlap_fraction",
+    "Fraction of the last deferred dispatch that overlapped the next "
+    "cycle's work (1.0 = fence never waited on, 0.0 = fully serialized)",
+)
+exchange_batched_iters_total = Counter(
+    f"{_SUBSYSTEM}_exchange_batched_iters_total",
+    "Gang iterations committed straight from a K-deep batched mesh "
+    "exchange instead of a per-iteration all-gather",
+)
+pipeline_fence_wait_seconds = Histogram(
+    f"{_SUBSYSTEM}_pipeline_fence_wait_seconds",
+    "Time a cycle waited on the previous cycle's dispatch fence before "
+    "taking its snapshot",
+    FINE_BUCKETS,
+)
+
 # -- per-queue SLO windows (kube_batch_tpu.obs SLOAccountant) ----------------
 # Sliding-window quantiles, refreshed by obs.slo.publish() at scrape
 # time — unlike the cumulative histograms above, these answer "is queue
@@ -490,6 +508,18 @@ def set_slo_quantile(kind: str, queue: str, quantile: str, value: float) -> None
         gauge.set(value, {"queue": queue, "quantile": quantile})
 
 
+def set_pipeline_overlap_fraction(fraction: float) -> None:
+    pipeline_overlap_fraction.set(fraction)
+
+
+def register_exchange_batched_iters(n: int) -> None:
+    exchange_batched_iters_total.inc(by=n)
+
+
+def observe_pipeline_fence_wait(seconds: float) -> None:
+    pipeline_fence_wait_seconds.observe(seconds)
+
+
 def _escape_label_value(value) -> str:
     """Prometheus text-format label escaping: backslash, double quote
     and newline must be escaped inside the quoted value (exposition
@@ -576,6 +606,9 @@ def render_prometheus_text() -> str:
         store_backend_rtt,
         unschedulable_total,
         would_fit_if_total,
+        pipeline_overlap_fraction,
+        exchange_batched_iters_total,
+        pipeline_fence_wait_seconds,
         slo_time_to_bind,
         slo_queue_wait,
     ]

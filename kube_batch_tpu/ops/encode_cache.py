@@ -418,6 +418,15 @@ class TensorArena:
     correct with no dirty feed at all: the comparison IS the truth.
     Host arrays handed to the arena must not be mutated afterwards (the
     encoder never does — every cycle builds fresh arrays).
+
+    **Pipelined mode** (``KBT_PIPELINE``): the slots double-buffer.
+    Each managed name keeps two (host memo, device buffer) banks and
+    ``device_view`` ping-pongs the active bank per cycle, so cycle N+1's
+    donated row-scatter mutates a buffer the still-running solve/dispatch
+    of cycle N is *not* reading. The row delta is computed against the
+    active bank's own host memo — a two-cycles-old baseline, so a warm
+    upload may scatter more rows than the single-buffer path, but the
+    result is byte-identical (the comparison is still the truth).
     """
 
     # node-axis slabs take the row-delta path; the group matrices are
@@ -432,12 +441,22 @@ class TensorArena:
     ROW_DELTA_MAX_FRACTION = 0.25
 
     def __init__(self) -> None:
-        self._slots: dict[str, _Slot] = {}
+        self._slots: dict[tuple, _Slot] = {}  # (name, bank) -> slot
+        self._bank = 0
         # counters exposed for tests/metrics narration
         self.reuses = 0
         self.row_updates = 0
         self.full_uploads = 0
         self.rows_uploaded = 0
+
+    @property
+    def bank(self) -> int:
+        return self._bank
+
+    def _flip_bank(self) -> None:
+        from kube_batch_tpu import pipeline
+
+        self._bank = (self._bank ^ 1) if pipeline.enabled() else 0
 
     def _placement_key(self, mesh, name: str):
         if mesh is None:
@@ -472,6 +491,7 @@ class TensorArena:
         everything else passes through for jit's own transfer (scalars
         and the small int/bool vectors are not worth residency)."""
         out = dict(arrays)
+        self._flip_bank()
         for name in self.MANAGED:
             host = arrays.get(name)
             if host is None:
@@ -488,7 +508,7 @@ class TensorArena:
 
     def upload(self, name: str, host, mesh=None):
         host = np.asarray(host)
-        slot = self._slots.get(name)
+        slot = self._slots.get((name, self._bank))
         placement = self._placement_key(mesh, name)
         if (
             slot is not None
@@ -521,12 +541,13 @@ class TensorArena:
                 self.reuses += 1
                 return slot.device
         dev = self._put(host, mesh, name)
-        self._slots[name] = _Slot(host, dev, placement)
+        self._slots[(name, self._bank)] = _Slot(host, dev, placement)
         self.full_uploads += 1
         return dev
 
     def clear(self) -> None:
         self._slots.clear()
+        self._bank = 0
 
 
 def _row_scatter(device_buf, rows: np.ndarray, new_host: np.ndarray):
@@ -638,10 +659,101 @@ def smoke() -> int:
     return rc
 
 
+def smoke_pipeline() -> int:
+    """Pipelined-vs-synchronous parity smoke (``--pipeline``, the verify
+    gate's second encode-cache check): one seeded world scheduled twice
+    — ``KBT_PIPELINE`` off, then on — must bind pod-for-pod identically,
+    with the pipelined run's dispatch actually deferred through the
+    fence and the arena ping-ponging its device banks across cycles."""
+    from kube_batch_tpu import actions, pipeline, plugins  # noqa: F401  (registries)
+    from kube_batch_tpu.conf import parse_scheduler_conf
+    from kube_batch_tpu.framework import close_session, get_action, open_session
+    from kube_batch_tpu.models import multi_queue
+    from kube_batch_tpu.testing import FakeCache
+
+    conf = parse_scheduler_conf(
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "  - name: conformance\n"
+        "- plugins:\n"
+        "  - name: predicates\n"
+        "  - name: nodeorder\n"
+    )
+    action = get_action("xla_allocate")
+
+    def run(pipelined: bool):
+        save = os.environ.get(pipeline.ENV)
+        os.environ[pipeline.ENV] = "1" if pipelined else "0"
+        pipeline.reset()
+        get().invalidate_all("smoke")
+        try:
+            cache = FakeCache(multi_queue(600, 96))
+            banks, deferred = [], []
+            cycle1_binds = None
+            for _ in range(2):  # two cycles: the bank must ping-pong
+                ssn = open_session(cache, conf.tiers)
+                action.execute(ssn)
+                banks.append(action._arena.bank)
+                deferred.append(getattr(ssn, "deferred_dispatch", None) is not None)
+                close_session(ssn)  # joins the deferred dispatch first
+                if cycle1_binds is None:
+                    cycle1_binds = dict(cache.binder.binds)
+            return cycle1_binds, banks, deferred
+        finally:
+            if save is None:
+                os.environ.pop(pipeline.ENV, None)
+            else:
+                os.environ[pipeline.ENV] = save
+            pipeline.reset()
+
+    problems = []
+    sync_binds, sync_banks, sync_deferred = run(False)
+    pipe_binds, pipe_banks, pipe_deferred = run(True)
+    if not sync_binds:
+        problems.append("synchronous run bound nothing")
+    if any(sync_deferred):
+        problems.append("synchronous run unexpectedly deferred its dispatch")
+    if not all(pipe_deferred):
+        problems.append("pipelined run never deferred its dispatch")
+    if len(set(pipe_banks)) != 2:
+        problems.append(
+            f"arena banks did not ping-pong across pipelined cycles: {pipe_banks}"
+        )
+    if len(set(sync_banks)) != 1:
+        problems.append(f"synchronous run flipped arena banks: {sync_banks}")
+    if pipe_binds != sync_binds:
+        diff = {
+            k: (sync_binds.get(k), pipe_binds.get(k))
+            for k in set(sync_binds) | set(pipe_binds)
+            if sync_binds.get(k) != pipe_binds.get(k)
+        }
+        problems.append(f"pipelined binds diverge from synchronous: {diff}")
+    if pipeline.fence.degraded_reason is not None:
+        problems.append(f"pipeline degraded during smoke: {pipeline.fence.degraded_reason}")
+    rc = 0
+    for p in problems:
+        print(f"pipeline smoke: {p}")
+        rc = 1
+    if rc == 0:
+        print(
+            "pipeline smoke: ok (pipelined cycle bind-for-bind identical to "
+            f"synchronous, dispatch deferred, arena banks {pipe_banks})"
+        )
+    return rc
+
+
 if __name__ == "__main__":
     # re-enter through the canonical module: `python -m` executes this
     # file as __main__, whose module-level singleton would otherwise be
     # a different object than the one encode_session uses
+    import sys as _sys
+
+    if "--pipeline" in _sys.argv[1:]:
+        from kube_batch_tpu.ops.encode_cache import smoke_pipeline as _canonical
+
+        raise SystemExit(_canonical())
     from kube_batch_tpu.ops.encode_cache import smoke as _canonical_smoke
 
     raise SystemExit(_canonical_smoke())

@@ -35,6 +35,28 @@ Block backends (``KBT_MESH_PALLAS`` or the ``block_impl`` argument):
 Speaks the same `SolveState` resume protocol as `ShardedSolver`, so the
 action's segmented pod-affinity pause/resume hybrid works unchanged,
 including the live InterPodAffinity re-fold between segments.
+
+K-deep batched exchange (``KBT_EXCHANGE_BATCH``, pipelined mode only):
+at mesh 8 the per-iteration all-gather dispatch is the floor — the
+block kernel itself runs exchange-free at ~1/3 of the measured
+per-iteration cost. With ``exchange_batch = K > 1`` each shard first
+**speculates** K gang iterations against a throwaway copy of the state,
+assuming its own candidate wins every round (losers' blocks are
+untouched by a loss, so a shard's speculative slab stays exact for as
+long as its recorded candidates keep being used), recording per depth
+the (score, global node index, fits-idle) triple plus the task fields
+that fully determine the block step (gid, has-sc, ports mask, req8,
+res8). One all-gather then ships the whole [K, record] buffer, and a
+collective-free **replay** loop re-runs the true replicated
+bookkeeping, taking each shard's candidate from its record at a
+per-shard depth pointer that advances only when that shard wins (or on
+a global abandon, which every speculative world agreed on because all
+recorded scores are -inf). A record is used only if its task fields
+equal the true current task's — the first mismatch ends the replay and
+the next outer iteration re-speculates from the authoritative state, so
+the batched program is bind-for-bind identical to the per-iteration
+exchange; gang members are near-identical pods, so in the common case
+all K iterations commit off a single exchange.
 """
 
 from __future__ import annotations
@@ -65,6 +87,30 @@ R8 = ps.R8
 _DROP = frozenset(NODE_AXIS_ARRAYS) | {"pod_sc", "aff_sc", "compat"}
 
 
+def _default_exchange_batch() -> int:
+    """K for the K-deep batched argmax exchange (``KBT_EXCHANGE_BATCH``).
+
+    Batching only pays when the dispatch it amortizes is overlapped
+    work, so K > 1 requires the pipelined-cycles gate (``KBT_PIPELINE``)
+    — without it the env knob is inert and the per-iteration exchange
+    runs unchanged. Tests and benches pass ``exchange_batch`` to the
+    solver explicitly to exercise the batched program in isolation.
+    """
+    from kube_batch_tpu import pipeline
+
+    if not pipeline.env_on():
+        return 1
+    raw = os.environ.get("KBT_EXCHANGE_BATCH", "").strip()
+    try:
+        k = int(raw) if raw else 4
+    except ValueError:
+        from kube_batch_tpu import log
+
+        log.errorf("bad KBT_EXCHANGE_BATCH=%r; using 4", raw)
+        k = 4
+    return max(1, min(k, 64))
+
+
 def _resolve_block_impl(spec: Optional[str], mesh: Mesh) -> str:
     if spec is None:
         spec = os.environ.get("KBT_MESH_PALLAS", "auto")
@@ -89,6 +135,7 @@ class ShardedPallasSolver:
         enable_proportion: bool = False,
         axis_name: str = AXIS_NAME,
         block_impl: Optional[str] = None,
+        exchange_batch: Optional[int] = None,
     ) -> None:
         # Arena handles (ops/encode_cache.TensorArena device arrays) are
         # accepted: the block path folds its statics host-side, so any
@@ -118,12 +165,22 @@ class ShardedPallasSolver:
         self._statics = self._fold_statics(arrays)
         self._tports = ps._ports_mask(np.asarray(arrays["task_ports"]))
         self._pod_sc = arrays.get("pod_sc")  # identity marker for refresh
+        self.exchange_batch = (
+            _default_exchange_batch()
+            if exchange_batch is None
+            else max(1, int(exchange_batch))
+        )
+        # Gang iterations committed straight from a K-deep batched
+        # exchange (accumulated across solve/resume calls; the action
+        # meters the delta into exchange_batched_iters_total).
+        self.batched_iters = 0
         self._fresh, self._resume = _blocked_programs(
             tuple(mesh.devices.flat),
             axis_name,
             enable_drf,
             enable_proportion,
             self.block_impl,
+            self.exchange_batch,
         )
 
     def _fold_statics(self, a: dict) -> dict:
@@ -154,8 +211,13 @@ class ShardedPallasSolver:
         a_call = dict(self.a)
         a_call["_tports"] = self._tports
         if state is None:
-            return self._fresh(a_call, self._statics)
-        return self._resume(a_call, self._statics, state)
+            out = self._fresh(a_call, self._statics)
+        else:
+            out = self._resume(a_call, self._statics, state)
+        if self.exchange_batch > 1:
+            out, n_batched = out
+            self.batched_iters += int(n_batched)
+        return out
 
 
 @lru_cache(maxsize=16)
@@ -165,12 +227,18 @@ def _blocked_programs(
     enable_drf: bool,
     enable_proportion: bool,
     block_impl: str,
+    exchange_batch: int = 1,
 ):
     """(fresh, resume) jitted SPMD programs for a mesh + block backend.
     Keyed on the device tuple and static flags; shapes (and the derived
     Nr_pad/Nr_loc/GT block geometry) are left to jit's per-signature
     cache, so stable encode buckets hit the compiled program across
-    cycles."""
+    cycles. With ``exchange_batch > 1`` the programs return
+    ``(SolveState, n_batched_iters)`` — the gang loop speculates K
+    iterations per shard, ships one [K, record] all-gather, and replays
+    validated records collective-free (module docstring has the full
+    scheme); the SolveState itself keeps the exact per-iteration
+    signature so the cross-tier resume protocol cannot drift."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -199,7 +267,8 @@ def _blocked_programs(
     def local(rep, a, sh):
         """One shard's SPMD body: the full gang loop over the local node
         block, replicated selection/bookkeeping, one argmax exchange per
-        iteration."""
+        gang iteration — or per K-iteration speculate/replay batch when
+        ``exchange_batch > 1``."""
         i32, f32 = jnp.int32, jnp.float32
         T, R = a["task_req"].shape
         J = a["job_min"].shape[0]
@@ -226,7 +295,14 @@ def _blocked_programs(
         max_iter = jnp.int32(T + J + Q + 1) + jnp.sum(host_only).astype(i32)
         lane1 = lax.broadcasted_iota(i32, (1, LANES), 1)
 
-        def body(s: SolveState) -> SolveState:
+        # The loop body is factored into prefix (replicated selection +
+        # task pop), taskvec (the fields that fully determine a task's
+        # block step — also the speculative-record validity key), the
+        # block call, and commit (everything after the winner is known),
+        # so the per-iteration exchange and the K-deep batched program
+        # share every line of bookkeeping and cannot drift.
+
+        def prefix(s: SolveState):
             # -- replicated queue + job selection (shared with the XLA twin)
             need_sel = s.cur < 0
             qsel, q_any, overused, jsel, j_any = select_queue_job(
@@ -248,8 +324,9 @@ def _blocked_programs(
             drop = (cur >= 0) & ~t_any
             pause = t_any & host_only[t]
             proc = t_any & ~pause
+            return cur, cur_c, t, drop, pause, proc, job_active, q_dropped
 
-            # -- fused block-local feasibility + score + argmax ------------
+        def taskvec(t):
             req8 = jnp.concatenate(
                 [jnp.asarray(a["task_req"][t], f32), jnp.zeros(R8 - R, f32)]
             )
@@ -257,37 +334,35 @@ def _blocked_programs(
                 [jnp.asarray(a["task_res"][t], f32), jnp.zeros(R8 - R, f32)]
             )
             gid = jnp.clip(a["task_gid"][t], 0, gt - 1).astype(i32)
-            tports = a["_tports"][t]
+            tports = a["_tports"][t].astype(i32)
+            has_sc = a["task_has_sc"][t].astype(i32)
+            return req8, res8, gid, tports, has_sc
+
+        def run_block(s, req8, res8, gid, tports, has_sc):
+            # -- fused block-local feasibility + score + argmax ------------
             fvec = jnp.concatenate([req8, res8, eps8, wvec, fpad])
             ivec = jnp.stack(
                 [
                     gid,
-                    a["task_has_sc"][t].astype(i32),
+                    has_sc,
                     tports,
                     off,
                     jnp.int32(sent),
                     jnp.int32(0), jnp.int32(0), jnp.int32(0),
                 ]
             )
-            bscore, bidx, bfits = block(
+            return block(
                 ivec, fvec,
                 sh["cnode"], sh["affw"], sh["nalloc"],
                 sh["nmax"], sh["nihs"], sh["nrhs"],
                 s.idle, s.rel, s.used, s.ntasks, s.nports,
             )
 
-            # -- the cross-chip argmax exchange: one packed all-gather per
-            # gang iteration; every shard then derives the same winner
-            # (max score, min global node index on ties — identical to
-            # the single-chip tie-break) and the winner's fits-idle bit
-            # comes from the shard that owns it.
-            packed = jnp.stack(
-                [bscore, bidx.astype(f32), bfits.astype(f32)]
-            )
-            allp = lax.all_gather(packed, axis_name)  # [mesh, 3]
-            scores = allp[:, 0]
-            idxs = allp[:, 1].astype(i32)
-            fits = allp[:, 2].astype(i32)
+        def winner(scores, idxs, fits):
+            # Every shard derives the same winner (max score, min global
+            # node index on ties — identical to the single-chip
+            # tie-break); the winner's fits-idle bit comes from the
+            # shard that owns it.
             big = jnp.max(scores)
             any_cand = big > NINF
             nb = jnp.min(jnp.where(scores == big, idxs, INT_MAX))
@@ -295,7 +370,12 @@ def _blocked_programs(
             fits_idle_nb = (
                 jnp.sum(jnp.where((scores == big) & (idxs == nb), fits, 0)) > 0
             )
+            return any_cand, nb, fits_idle_nb
 
+        def commit(
+            s, cur, cur_c, t, drop, pause, proc, job_active, q_dropped,
+            req8, res8, tports, any_cand, nb, fits_idle_nb,
+        ) -> SolveState:
             abandon = proc & ~any_cand
             assign = proc & any_cand
             do_alloc = assign & fits_idle_nb
@@ -386,12 +466,159 @@ def _blocked_programs(
                 paused_at=jnp.where(pause, t, jnp.int32(-1)),
             )
 
+        def body(s: SolveState) -> SolveState:
+            cur, cur_c, t, drop, pause, proc, job_active, q_dropped = prefix(s)
+            req8, res8, gid, tports, has_sc = taskvec(t)
+            bscore, bidx, bfits = run_block(s, req8, res8, gid, tports, has_sc)
+
+            # -- the cross-chip argmax exchange: one packed all-gather per
+            # gang iteration.
+            packed = jnp.stack(
+                [bscore, bidx.astype(f32), bfits.astype(f32)]
+            )
+            allp = lax.all_gather(packed, axis_name)  # [mesh, 3]
+            any_cand, nb, fits_idle_nb = winner(
+                allp[:, 0], allp[:, 1].astype(i32), allp[:, 2].astype(i32)
+            )
+            return commit(
+                s, cur, cur_c, t, drop, pause, proc, job_active, q_dropped,
+                req8, res8, tports, any_cand, nb, fits_idle_nb,
+            )
+
         def cond(s: SolveState):
             return (
                 ((s.cur >= 0) | jnp.any(s.job_active))
                 & (s.it < max_iter)
                 & (s.paused_at < 0)
             )
+
+        # -- K-deep batched exchange: speculate, one gather, replay --------
+        K = exchange_batch
+        REC_F = 1 + 2 * R8  # score, req8, res8
+
+        def spec_body(c):
+            # One speculative gang iteration on a throwaway state: this
+            # shard's own candidate is assumed to win, so its block stays
+            # exact for its own chain; proc iterations append a record.
+            s, w, rf, ri = c
+            cur, cur_c, t, drop, pause, proc, job_active, q_dropped = prefix(s)
+            req8, res8, gid, tports, has_sc = taskvec(t)
+            bscore, bidx, bfits = run_block(s, req8, res8, gid, tports, has_sc)
+            any_cand = bscore > NINF
+            nb = jnp.minimum(bidx.astype(i32), sent - 1)
+            fits_idle_nb = bfits.astype(i32) > 0
+            s2 = commit(
+                s, cur, cur_c, t, drop, pause, proc, job_active, q_dropped,
+                req8, res8, tports, any_cand, nb, fits_idle_nb,
+            )
+            slot = jnp.where(proc, w, jnp.int32(K))  # K = out of bounds: drop
+            rf = rf.at[slot].set(
+                jnp.concatenate([bscore[None].astype(f32), req8, res8]),
+                mode="drop",
+            )
+            ri = ri.at[slot].set(
+                jnp.stack(
+                    [bidx.astype(i32), bfits.astype(i32), gid, has_sc, tports]
+                ),
+                mode="drop",
+            )
+            return s2, w + proc.astype(i32), rf, ri
+
+        def spec_cond(c):
+            s, w, _, _ = c
+            return (w < K) & cond(s)
+
+        def replay_cond(c):
+            s, _, live, _ = c
+            return live & cond(s)
+
+        def make_replay_body(allf, alli, nrec):
+            shard_ids = jnp.arange(m, dtype=i32)
+
+            def replay_body(c):
+                # One true gang iteration, collective-free: candidates
+                # come from the gathered records at each shard's depth
+                # pointer. A record is usable only while its task fields
+                # equal the true current task's; the first mismatch (or
+                # an exhausted shard) ends the replay un-committed and
+                # the outer loop re-speculates from the true state.
+                s, d, live, nc = c
+                cur, cur_c, t, drop, pause, proc, job_active, q_dropped = (
+                    prefix(s)
+                )
+                req8, res8, gid, tports, has_sc = taskvec(t)
+                dcl = jnp.minimum(d, K - 1)
+                rowf = jnp.take_along_axis(
+                    allf, dcl[:, None, None], axis=1
+                )[:, 0]  # [mesh, REC_F]
+                rowi = jnp.take_along_axis(
+                    alli, dcl[:, None, None], axis=1
+                )[:, 0]  # [mesh, 5]
+                scores = rowf[:, 0]
+                idxs = rowi[:, 0]
+                fits = rowi[:, 1]
+                valid = jnp.all(
+                    (d < nrec)
+                    & (rowi[:, 2] == gid)
+                    & (rowi[:, 3] == has_sc)
+                    & (rowi[:, 4] == tports)
+                    & jnp.all(rowf[:, 1 : 1 + R8] == req8[None, :], axis=1)
+                    & jnp.all(rowf[:, 1 + R8 :] == res8[None, :], axis=1)
+                )
+                any_cand, nb, fits_idle_nb = winner(scores, idxs, fits)
+                s2 = commit(
+                    s, cur, cur_c, t, drop, pause, proc, job_active,
+                    q_dropped, req8, res8, tports, any_cand, nb, fits_idle_nb,
+                )
+                # Depth pointers: the winning shard consumed its record;
+                # a global abandon consumed everyone's (all recorded
+                # scores were -inf, so every speculative world abandoned
+                # this task too, with no block change on either side).
+                win_shard = (nb // (nr_loc * LANES)).astype(i32)
+                d2 = jnp.where(
+                    any_cand,
+                    jnp.where(shard_ids == win_shard, d + 1, d),
+                    d + 1,
+                )
+                d2 = jnp.where(proc, d2, d)
+                ok = (~proc) | valid
+                s3 = jax.tree_util.tree_map(
+                    lambda nv, ov: jnp.where(ok, nv, ov), s2, s
+                )
+                return (
+                    s3,
+                    jnp.where(ok, d2, d),
+                    live & ok,
+                    nc + (proc & ok).astype(i32),
+                )
+
+            return replay_body
+
+        def outer_cond(c):
+            s, _ = c
+            return cond(s)
+
+        def outer_body(c):
+            s, nb_tot = c
+            rf0 = jnp.zeros((K, REC_F), f32)
+            ri0 = jnp.zeros((K, 5), i32)
+            _, w, rf, ri = lax.while_loop(
+                spec_cond, spec_body, (s, jnp.int32(0), rf0, ri0)
+            )
+            allf = lax.all_gather(rf, axis_name)  # [mesh, K, REC_F]
+            alli = lax.all_gather(ri, axis_name)  # [mesh, K, 5]
+            nrec = lax.all_gather(w, axis_name)  # [mesh]
+            # Replay iteration 0 is always committable: speculation and
+            # replay both start from the true state, and the native
+            # selection/drop steps before the first proc iteration are
+            # replicated-deterministic — so depth-0 records are exact
+            # and every outer iteration advances s.it by at least one.
+            s2, _, _, nc = lax.while_loop(
+                replay_cond,
+                make_replay_body(allf, alli, nrec),
+                (s, jnp.zeros(m, i32), jnp.bool_(True), jnp.int32(0)),
+            )
+            return s2, nb_tot + nc
 
         (
             it, step, cur, ptr, an, ak, ap,
@@ -406,13 +633,21 @@ def _blocked_programs(
             job_alloc=job_alloc, q_alloc=q_alloc, q_alloc_has_sc=qahs,
             paused_at=paused,
         )
-        out = lax.while_loop(cond, body, state)
+        if exchange_batch > 1:
+            out, n_batched = lax.while_loop(
+                outer_cond, outer_body, (state, jnp.int32(0))
+            )
+        else:
+            out = lax.while_loop(cond, body, state)
+            n_batched = None
         rep_out = (
             out.it, out.step, out.cur, out.ptr,
             out.assigned_node, out.assigned_kind, out.assign_pos,
             out.ready_cnt, out.job_active, out.q_dropped,
             out.job_alloc, out.q_alloc, out.q_alloc_has_sc, out.paused_at,
         )
+        if n_batched is not None:
+            rep_out = rep_out + (n_batched,)
         sh_out = {
             "idle": out.idle, "rel": out.rel, "used": out.used,
             "ntasks": out.ntasks, "nports": out.nports,
@@ -484,6 +719,10 @@ def _blocked_programs(
         )
         a_rep = {k: v for k, v in a.items() if k not in _DROP}
         rep_out, sh_out = smapped(rep_in, a_rep, sh_in)
+        n_batched = None
+        if exchange_batch > 1:
+            *rep_flat, n_batched = rep_out
+            rep_out = tuple(rep_flat)
 
         def unfold2(x):
             return x.transpose(1, 2, 0).reshape(nf, R8)[:n, :R]
@@ -502,7 +741,7 @@ def _blocked_programs(
             it, step, cur, ptr, an, ak, ap,
             ready_cnt, job_active, q_dropped, job_alloc, q_alloc, qahs, paused,
         ) = rep_out
-        return SolveState(
+        final = SolveState(
             it=it, step=step, cur=cur, ptr=ptr,
             assigned_node=an, assigned_kind=ak, assign_pos=ap,
             idle=unfold2(sh_out["idle"]),
@@ -514,6 +753,9 @@ def _blocked_programs(
             job_alloc=job_alloc, q_alloc=q_alloc, q_alloc_has_sc=qahs,
             paused_at=paused,
         )
+        if n_batched is not None:
+            return final, n_batched
+        return final
 
     fresh = jax.jit(partial(run, state=None))
     resume = jax.jit(run)

@@ -1,0 +1,225 @@
+"""Pipelined scheduling cycles (``KBT_PIPELINE``, default off).
+
+The synchronous cycle is encode-upload -> device solve -> replay/dispatch,
+back to back. This module owns the machinery that overlaps the third
+phase with the *next* cycle:
+
+- :class:`DispatchFence` — a process-wide rendezvous between cycle N's
+  deferred replay/dispatch (submitted onto the cache's kb-write pool by
+  ``actions/xla_allocate``) and cycle N+1, which must not snapshot the
+  cluster until N's binds have landed. The fence preserves the
+  statement/journal ordering the synchronous path gets for free:
+  dispatch N < snapshot N+1 < dispatch N+1.
+- **Loud degradation** — a fence timeout (wedged writer pool, or the
+  ``pipeline.fence`` fault point in a drill) marks the pipeline
+  degraded: :func:`enabled` flips false, every subsequent cycle runs the
+  synchronous path, a degraded-cycle metric and a flight-recorder dump
+  fire. Degradation is sticky until :func:`reset` (operator action /
+  test hygiene) because a fence that timed out once has already proven
+  the overlap assumption wrong for this process.
+- overlap accounting — ``pipeline_overlap_fraction`` is
+  ``(dispatch_duration - fence_wait) / dispatch_duration``: 1.0 means
+  the dispatch finished entirely under the next cycle's work, 0.0 means
+  the fence serialized the cycles after all. ``pipeline_fence_wait_seconds``
+  records every wait.
+
+Sessions carry the in-flight work as ``ssn.deferred_dispatch`` (a
+``concurrent.futures.Future``); ``framework.close_session`` joins it
+before the commit write-back so job status never races the binds it
+describes. Caches without a writer pool (``testing.FakeCache``) fall
+back to a lazy module-level single-thread executor, so the pipelined
+path is testable without the full cache daemon.
+
+Env knobs: ``KBT_PIPELINE`` turns the pipeline on;
+``KBT_PIPELINE_FENCE_TIMEOUT_S`` bounds the fence wait (default 30s).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable, Optional
+
+from kube_batch_tpu import faults, log, metrics
+
+__all__ = [
+    "ENV",
+    "FENCE_TIMEOUT_ENV",
+    "DispatchFence",
+    "fence",
+    "enabled",
+    "env_on",
+    "fence_timeout_s",
+    "submit",
+    "join_session",
+    "reset",
+]
+
+ENV = "KBT_PIPELINE"
+FENCE_TIMEOUT_ENV = "KBT_PIPELINE_FENCE_TIMEOUT_S"
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def env_on() -> bool:
+    """The raw env gate, ignoring degradation state."""
+    return (os.environ.get(ENV, "") or "").strip().lower() in _TRUTHY
+
+
+def enabled() -> bool:
+    """Pipelined cycles are on: env gate set AND the fence has not
+    degraded this process to the synchronous path."""
+    return env_on() and fence.degraded_reason is None
+
+
+def fence_timeout_s() -> float:
+    raw = os.environ.get(FENCE_TIMEOUT_ENV, "")
+    try:
+        return float(raw) if raw else 30.0
+    except ValueError:
+        log.errorf("%s=%r is not a number; using 30", FENCE_TIMEOUT_ENV, raw)
+        return 30.0
+
+
+class DispatchFence:
+    """Rendezvous between cycle N's deferred dispatch and cycle N+1.
+
+    ``arm(future)`` is called by the action after submitting the
+    post-solve phase; ``wait()`` is called at the top of the next cycle
+    (and by the bench harness between repeats). ``wait()`` returning
+    False means the caller must NOT proceed with a pipelined cycle: the
+    dispatch either timed out (still in flight — the future stays armed
+    so a later wait can re-join it) or raised (already logged by the
+    finisher; the fence only records the degradation).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._future: Optional[Future] = None
+        self._dispatch_s = 0.0
+        self.degraded_reason: Optional[str] = None
+
+    def arm(self, future: Future) -> None:
+        with self._lock:
+            self._future = future
+
+    def pending(self) -> bool:
+        with self._lock:
+            return self._future is not None and not self._future.done()
+
+    def record_dispatch_seconds(self, seconds: float) -> None:
+        """Called by the deferred finisher with its own wall duration —
+        the denominator of the overlap fraction."""
+        with self._lock:
+            self._dispatch_s = float(seconds)
+
+    def degrade(self, reason: str) -> None:
+        """Sticky: flips :func:`enabled` false for the process, loudly."""
+        if self.degraded_reason is None:
+            self.degraded_reason = reason
+            log.errorf(
+                "pipeline degraded to synchronous cycles: %s "
+                "(sticky until pipeline.reset())", reason,
+            )
+            metrics.register_degraded_cycle("pipeline", reason.split(":")[0])
+            from kube_batch_tpu import obs
+
+            obs.recorder.dump(reason="pipeline.degraded", min_interval_s=5.0)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the in-flight deferred dispatch. True = clean (or
+        nothing in flight); False = the caller must take the synchronous
+        path (the fence has already degraded the pipeline)."""
+        with self._lock:
+            fut = self._future
+        if fut is None:
+            return True
+        if timeout is None:
+            timeout = fence_timeout_s()
+        wedged = faults.should_fire("pipeline.fence")
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            if wedged:
+                raise _FutureTimeout()
+            fut.result(timeout=timeout)
+        except _FutureTimeout:
+            ok = False
+            reason = (
+                "fault injected: pipeline.fence" if wedged
+                else f"fence timeout: dispatch still in flight after {timeout:g}s"
+            )
+            self.degrade(reason)
+            # the future stays armed: the dispatch may still land, and
+            # the (now synchronous) next cycle must re-join it first
+        except Exception as e:  # noqa: BLE001 - finisher already logged it
+            ok = False
+            self.degrade(f"deferred dispatch raised {type(e).__name__}: {e}")
+            with self._lock:
+                self._future = None
+        waited = time.perf_counter() - t0
+        metrics.observe_pipeline_fence_wait(waited)
+        with self._lock:
+            if ok:
+                self._future = None
+            d = self._dispatch_s
+        if ok and d > 0.0:
+            metrics.set_pipeline_overlap_fraction(
+                max(0.0, min(1.0, (d - waited) / d))
+            )
+        return ok
+
+    def reset(self) -> None:
+        with self._lock:
+            fut = self._future
+            self._future = None
+            self._dispatch_s = 0.0
+        self.degraded_reason = None
+        if fut is not None and not fut.done():
+            try:
+                fut.result(timeout=fence_timeout_s())
+            except Exception:  # noqa: BLE001 - reset is best-effort teardown
+                pass
+
+
+fence = DispatchFence()
+
+# Lazy fallback executor for caches without a kb-write pool (FakeCache,
+# the interleave harness): one thread keeps the deferred dispatches of a
+# single scheduler strictly ordered, which is all the fence needs.
+_fallback: Optional[ThreadPoolExecutor] = None
+_fallback_lock = threading.Lock()
+
+
+def submit(cache, fn: Callable[[], None]) -> Future:
+    """Submit the post-solve dispatch closure: onto the cache's writer
+    pool when it exposes one, else onto the module fallback thread."""
+    sub = getattr(cache, "submit_dispatch", None)
+    if callable(sub):
+        return sub(fn)
+    global _fallback
+    with _fallback_lock:
+        if _fallback is None:
+            _fallback = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kbt-pipeline"
+            )
+    return _fallback.submit(fn)
+
+
+def join_session(ssn, timeout: Optional[float] = None) -> None:
+    """Block until ``ssn``'s deferred dispatch (if any) has landed,
+    re-raising its exception. close_session calls this before the commit
+    write-back; benches call it before reading binder state."""
+    fut = getattr(ssn, "deferred_dispatch", None)
+    if fut is None:
+        return
+    ssn.deferred_dispatch = None
+    fut.result(timeout=timeout if timeout is not None else fence_timeout_s())
+
+
+def reset() -> None:
+    """Clear fence + degradation state (test hygiene between drills)."""
+    fence.reset()

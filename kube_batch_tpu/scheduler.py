@@ -28,7 +28,7 @@ from typing import Optional
 
 import kube_batch_tpu.actions  # noqa: F401  (registers the action pipeline)
 import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
-from kube_batch_tpu import faults, log, metrics, obs
+from kube_batch_tpu import faults, log, metrics, obs, pipeline
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.obs import explain as _obs_explain
 from kube_batch_tpu.conf import (
@@ -249,6 +249,17 @@ class Scheduler:
         trigger = self._stream_trigger
         if st is None or trigger is None:
             return False
+        # A previous full cycle's deferred dispatch must land before the
+        # micro-cycle clones jobs (micro-cycles themselves never defer —
+        # their outcome accounting reads the session synchronously).
+        if not pipeline.fence.wait():
+            metrics.register_micro_cycle("fence")
+            log.errorf(
+                "dispatch fence did not clear before micro-cycle; degrading "
+                "to a full cycle (pipeline degraded: %s)",
+                pipeline.fence.degraded_reason,
+            )
+            return False
         if not st.valid:
             metrics.register_micro_cycle("stale")
             log.V(4).infof("micro-cycle skipped: resident table invalid (%s)", st.reason)
@@ -363,6 +374,20 @@ class Scheduler:
         self._load_conf()  # before the span: a conf push may flip tracing
 
         with obs.span("cycle") as cspan:
+            # Dispatch fence (pipeline.py, KBT_PIPELINE): the previous
+            # cycle's deferred dispatch must land before this cycle
+            # snapshots — same ordering the synchronous path gets for
+            # free. A timeout degrades the pipeline to synchronous
+            # cycles (sticky, loud) and skips this cycle; the wedged
+            # dispatch stays armed so the next cycle re-joins it.
+            if not pipeline.fence.wait():
+                cspan.set_attr("skipped", "pipeline_fence")
+                log.errorf(
+                    "dispatch fence did not clear; skipping this cycle "
+                    "(pipeline degraded: %s)", pipeline.fence.degraded_reason,
+                )
+                return
+
             # Bounded-staleness guard: scheduling over a stale mirror binds
             # pods onto nodes that may no longer exist — refuse the cycle
             # and let the watch client catch up (the k8s contract is the
@@ -404,9 +429,14 @@ class Scheduler:
             # every pre-dispatch boundary).
             ssn.cycle_budget = budget
             aborted: Optional[CycleDeadlineExceeded] = None
+            deferred_finish = False
             try:
                 for action in self.actions:
                     try:
+                        # a previous action's deferred dispatch must land
+                        # before the next action reads the session
+                        if ssn.deferred_dispatch is not None:
+                            pipeline.join_session(ssn)
                         action_start = time.perf_counter()
                         action.execute(ssn)
                         metrics.update_action_duration(
@@ -419,18 +449,28 @@ class Scheduler:
                         aborted = e
                         break
             finally:
-                # streaming harvest: grab the session's node table BEFORE
-                # close_session rebinds it — micro-cycles solve against this
-                # resident state until the next full cycle replaces it
-                if self._stream_state is not None:
-                    self._stream_state.adopt_full_cycle(ssn, aborted=aborted is not None)
-                # discard on abort: skip the status write-back so the
-                # store stays byte-identical to the cycle's start (every
-                # abort point is pre-dispatch)
-                close_session(ssn, discard=aborted is not None)
-                metrics.update_e2e_duration(time.perf_counter() - cycle_start)
-                metrics.schedule_attempts.inc()
-                log.V(4).infof("End scheduling ...")
+                if ssn.deferred_dispatch is not None and aborted is None:
+                    # Pipelined cycle: the last action's dispatch is in
+                    # flight on the kb-write pool. Chain the cycle's tail
+                    # (streaming harvest, close, e2e metrics, detector
+                    # verify) behind its Future so run_once returns and
+                    # the next cycle's encode/solve overlaps the
+                    # dispatch; the fence keeps the cycles ordered.
+                    deferred_finish = True
+                    self._finish_deferred(ssn, cycle_start, detector)
+                else:
+                    # streaming harvest: grab the session's node table BEFORE
+                    # close_session rebinds it — micro-cycles solve against this
+                    # resident state until the next full cycle replaces it
+                    if self._stream_state is not None:
+                        self._stream_state.adopt_full_cycle(ssn, aborted=aborted is not None)
+                    # discard on abort: skip the status write-back so the
+                    # store stays byte-identical to the cycle's start (every
+                    # abort point is pre-dispatch)
+                    close_session(ssn, discard=aborted is not None)
+                    metrics.update_e2e_duration(time.perf_counter() - cycle_start)
+                    metrics.schedule_attempts.inc()
+                    log.V(4).infof("End scheduling ...")
             if aborted is not None:
                 metrics.register_cycle_overrun("hard")
                 cspan.set_attr("aborted", str(aborted))
@@ -445,8 +485,31 @@ class Scheduler:
                 self._arm_tier_downgrade(budget)
             else:
                 self._soft_overruns = 0  # a within-budget cycle clears the streak
-            if detector is not None:
+            if detector is not None and not deferred_finish:
                 detector.verify()  # raises CacheMutationError on violation
+
+    def _finish_deferred(self, ssn, cycle_start: float, detector) -> None:
+        """Chain a pipelined cycle's tail behind its deferred dispatch.
+        Runs on the kb-write pool thread when the dispatch lands; any
+        failure is logged and degrades the pipeline (the synchronous
+        path would have surfaced it through run()'s catch-log)."""
+        stream_state = self._stream_state
+
+        def _finish(_fut) -> None:
+            try:
+                if stream_state is not None:
+                    stream_state.adopt_full_cycle(ssn, aborted=False)
+                close_session(ssn)  # joins the (now done) deferred future
+                metrics.update_e2e_duration(time.perf_counter() - cycle_start)
+                metrics.schedule_attempts.inc()
+                if detector is not None:
+                    detector.verify()  # raises CacheMutationError on violation
+                log.V(4).infof("End scheduling ...")
+            except Exception as e:  # noqa: BLE001 - must not kill the pool thread
+                log.errorf("pipelined cycle tail failed: %s", e)
+                pipeline.fence.degrade(f"cycle tail raised {type(e).__name__}: {e}")
+
+        ssn.deferred_dispatch.add_done_callback(_finish)
 
     def _arm_tier_downgrade(self, budget: CycleBudget) -> None:
         """Soft overrun: consecutive slow cycles trip the breaker of the

@@ -1,0 +1,451 @@
+"""Pipelined scheduling cycles (ISSUE 13, ``KBT_PIPELINE``).
+
+The overlapped encode/solve/dispatch path must be invisible except in
+wall-clock: every test here states an equality against the synchronous
+path the feature is allowed to overlap but never allowed to change.
+
+- **fence semantics**: arm/wait rendezvous, overlap accounting, sticky
+  loud degradation on timeout / fault / dispatch exception, re-join of
+  a wedged future, reset hygiene;
+- **chaos**: the ``pipeline.fence`` fault point mid-overlap degrades
+  the process to synchronous cycles with zero lost and zero duplicate
+  binds (detector armed suite-wide by conftest);
+- **parity**: KBT_PIPELINE x streaming micro-cycles place bind-for-bind
+  identically to the plain periodic synchronous loop over the same
+  arrivals;
+- **crash consistency**: a leader killed inside the deferred dispatch
+  leaves the PR-3 write-intent journal holding the in-flight suffix,
+  and a standby's reconciliation + one full cycle converge to the
+  uninterrupted twin with zero lost and zero duplicate binds;
+- **arena**: the double-buffered ``TensorArena`` ping-pongs banks per
+  cycle and stays byte-identical to the host arrays it mirrors.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import faults, metrics, pipeline
+from kube_batch_tpu.cache import ClusterStore, SchedulerCache
+from kube_batch_tpu.cache.cache import StoreBinder
+from kube_batch_tpu.cache.store import PODS, EventHandler
+from kube_batch_tpu.recovery import WriteIntentJournal, reconcile_journal
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+_ENV_KEYS = (pipeline.ENV, pipeline.FENCE_TIMEOUT_ENV, "KBT_EXCHANGE_BATCH")
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+    pipeline.reset()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    pipeline.reset()
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+
+
+def wait_until(pred, timeout=20.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# The conf every e2e below schedules with: allocation routed through
+# xla_allocate (the only action with a deferrable post-solve phase),
+# min_device_pairs 0 so the tiny model clusters stay on the device path
+# (the same pin the sharded parity suites use), and no drf/proportion
+# so streaming micro-tiers and full cycles state exact parity.
+PIPE_CONF = """
+actions: "enqueue, xla_allocate, backfill"
+actionArguments:
+  xla_allocate:
+    min_device_pairs: "0"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: predicates
+  - name: nodeorder
+streaming: {streaming}
+"""
+
+
+def seed_cluster(store: ClusterStore, nodes: int = 4) -> None:
+    store.create_queue(build_queue("default"))
+    for i in range(nodes):
+        store.create_node(
+            build_node(f"n{i}", build_resource_list(cpu=16, memory="16Gi", pods=64))
+        )
+
+
+def arrive_gang(store: ClusterStore, name: str, members: int) -> None:
+    store.create_pod_group(build_pod_group(name, min_member=members))
+    for m in range(members):
+        store.create_pod(
+            build_pod(
+                name=f"{name}-p{m}", group_name=name,
+                req=build_resource_list(cpu=1, memory="512Mi"),
+            )
+        )
+
+
+def make_scheduler(store, tmp_path, streaming=False, period=5.0,
+                   journal=None, binder=None):
+    conf = tmp_path / f"conf-{streaming}.yaml"
+    conf.write_text(PIPE_CONF.format(streaming=str(streaming).lower()))
+    cache = SchedulerCache(store, journal=journal, binder=binder)
+    return cache, Scheduler(cache, scheduler_conf=str(conf), schedule_period=period)
+
+
+def placements(store) -> dict:
+    return {f"{p.namespace}/{p.name}": p.node_name for p in store.list(PODS)}
+
+
+def all_bound(store) -> bool:
+    pods = store.list(PODS)
+    return bool(pods) and all(p.node_name for p in pods)
+
+
+def count_bind_events(store) -> dict:
+    counts: dict[str, int] = {}
+
+    def on_update(old, new):
+        if not old.node_name and new.node_name:
+            key = f"{new.namespace}/{new.name}"
+            counts[key] = counts.get(key, 0) + 1
+
+    store.add_event_handler(PODS, EventHandler(on_update=on_update))
+    return counts
+
+
+# -- fence units --------------------------------------------------------------
+
+
+def test_fence_clean_wait_clears_and_records_overlap():
+    os.environ[pipeline.ENV] = "1"
+    assert pipeline.enabled()
+    assert pipeline.fence.wait(), "nothing armed must be a clean wait"
+
+    fut: Future = Future()
+    fut.set_result(None)
+    pipeline.fence.arm(fut)
+    pipeline.fence.record_dispatch_seconds(0.25)
+    assert not pipeline.fence.pending()  # armed but already landed
+    assert pipeline.fence.wait()
+    assert pipeline.fence.degraded_reason is None
+    # dispatch landed before the wait started: full overlap
+    assert metrics.pipeline_overlap_fraction.value() == pytest.approx(1.0, abs=0.05)
+    # a clean wait disarms: the next wait has nothing to join
+    assert pipeline.fence.wait()
+
+
+def test_fence_timeout_is_sticky_and_keeps_the_future_armed():
+    os.environ[pipeline.ENV] = "1"
+    pool = ThreadPoolExecutor(max_workers=1)
+    release = threading.Event()
+    try:
+        fut = pool.submit(release.wait, 10.0)
+        pipeline.fence.arm(fut)
+        assert pipeline.enabled()
+        assert not pipeline.fence.wait(timeout=0.05)
+        assert "timeout" in pipeline.fence.degraded_reason
+        assert not pipeline.enabled(), "degradation must be sticky"
+        assert pipeline.fence.pending(), "wedged future must stay armed"
+        # the dispatch eventually lands; the next (synchronous) cycle
+        # re-joins it cleanly -- but the process stays degraded
+        release.set()
+        fut.result(timeout=5.0)
+        assert pipeline.fence.wait()
+        assert not pipeline.enabled()
+        pipeline.reset()
+        assert pipeline.enabled(), "reset is the only way back"
+    finally:
+        release.set()
+        pool.shutdown(wait=True)
+
+
+def test_fence_fault_point_degrades():
+    os.environ[pipeline.ENV] = "1"
+    fut: Future = Future()
+    fut.set_result(None)
+    pipeline.fence.arm(fut)
+    faults.registry.arm("pipeline.fence", count=1)
+    assert not pipeline.fence.wait()
+    assert "pipeline.fence" in pipeline.fence.degraded_reason
+    assert not pipeline.enabled()
+
+
+def test_fence_dispatch_exception_degrades_and_disarms():
+    os.environ[pipeline.ENV] = "1"
+    fut: Future = Future()
+    fut.set_exception(RuntimeError("replay exploded"))
+    pipeline.fence.arm(fut)
+    assert not pipeline.fence.wait()
+    assert "RuntimeError" in pipeline.fence.degraded_reason
+    assert not pipeline.fence.pending(), "a raised dispatch is finished"
+
+
+def test_submit_uses_cache_pool_else_module_fallback():
+    ran = []
+    # SchedulerCache without run(): submit_dispatch executes inline and
+    # hands back an already-done future (synchronous degenerate case)
+    cache = SchedulerCache(ClusterStore())
+    fut = pipeline.submit(cache, lambda: ran.append("inline"))
+    assert fut.done() and ran == ["inline"]
+
+    # an inline dispatch that dies carries the exception in the future
+    # instead of raising at submission (the fence join re-raises it)
+    def boom():
+        raise ValueError("carried")
+
+    assert isinstance(pipeline.submit(cache, boom).exception(), ValueError)
+
+    # objects with no submit_dispatch ride the module fallback thread
+    class PoolLess:
+        pass
+
+    fut2 = pipeline.submit(PoolLess(), lambda: ran.append("fallback"))
+    fut2.result(timeout=5.0)
+    assert ran[-1] == "fallback"
+
+
+def test_join_session_reraises_and_pops():
+    class S:
+        pass
+
+    ssn = S()
+    fut: Future = Future()
+    fut.set_exception(ValueError("deferred death"))
+    ssn.deferred_dispatch = fut
+    with pytest.raises(ValueError):
+        pipeline.join_session(ssn)
+    assert ssn.deferred_dispatch is None
+    pipeline.join_session(S())  # no deferred work: no-op
+
+
+# -- arena double-buffering ---------------------------------------------------
+
+
+def test_arena_bank_pingpong_matches_hosts():
+    from kube_batch_tpu.ops.encode_cache import TensorArena
+
+    base = np.arange(32, dtype=np.float32).reshape(8, 4)
+
+    # synchronous mode: the bank is pinned at 0
+    arena = TensorArena()
+    arena.device_view({"node_idle": base})
+    arena.device_view({"node_idle": base})
+    assert arena.bank == 0
+    assert arena.reuses >= 1  # same object: outright reuse
+
+    os.environ[pipeline.ENV] = "1"
+    arena = TensorArena()
+    a1 = {"node_idle": base}
+    v1 = arena.device_view(a1)
+    b1 = arena.bank
+    a2 = {"node_idle": base.copy()}
+    a2["node_idle"][3] += 1.0
+    v2 = arena.device_view(a2)
+    assert arena.bank != b1, "pipelined uploads must ping-pong banks"
+    # cycle N+1's upload never touched the bank cycle N still reads
+    np.testing.assert_array_equal(np.asarray(v1["node_idle"]), a1["node_idle"])
+    np.testing.assert_array_equal(np.asarray(v2["node_idle"]), a2["node_idle"])
+    # third cycle returns to the first bank: the row delta runs against
+    # that bank's own (two-cycles-old) memo and stays byte-identical
+    a3 = {"node_idle": a2["node_idle"].copy()}
+    a3["node_idle"][5] -= 2.0
+    v3 = arena.device_view(a3)
+    assert arena.bank == b1
+    np.testing.assert_array_equal(np.asarray(v3["node_idle"]), a3["node_idle"])
+    assert arena.full_uploads == 2, "one cold upload per bank"
+    assert arena.row_updates == 1, "the re-visit scatters rows in place"
+    assert arena.rows_uploaded == 2  # rows 3 and 5 vs the bank's memo
+
+
+# -- chaos: fault mid-overlap degrades to synchronous -------------------------
+
+
+def test_chaos_fence_fault_mid_overlap_degrades_cleanly(tmp_path):
+    """Cycle N defers its dispatch; the ``pipeline.fence`` fault ambushes
+    cycle N+1's fence wait. The cycle is skipped, the pipeline degrades
+    (sticky, loud), and the following synchronous cycles keep binding:
+    zero lost, zero duplicate binds."""
+    os.environ[pipeline.ENV] = "1"
+    store = ClusterStore()
+    seed_cluster(store)
+    bind_counts = count_bind_events(store)
+    _, sched = make_scheduler(store, tmp_path)
+
+    arrive_gang(store, "g0", members=3)
+    sched.run_once()
+    assert pipeline.fence._dispatch_s > 0.0, (
+        "the first cycle never deferred its dispatch -- the pipelined "
+        "path did not engage and this test would check nothing"
+    )
+    assert all_bound(store)
+
+    faults.registry.arm("pipeline.fence", count=1)
+    arrive_gang(store, "g1", members=3)
+    sched.run_once()  # fence wait fires the fault: cycle skipped
+    assert "pipeline.fence" in pipeline.fence.degraded_reason
+    assert not pipeline.enabled()
+    _, _, fired = faults.registry.active()["pipeline.fence"]
+    assert fired == 1
+
+    sched.run_once()  # synchronous backstop serves the skipped arrivals
+    arrive_gang(store, "g2", members=3)
+    sched.run_once()
+    assert all_bound(store)
+    assert len(bind_counts) == 9
+    assert all(n == 1 for n in bind_counts.values()), f"duplicate binds: {bind_counts}"
+
+
+# -- parity: pipelined x streaming vs the periodic synchronous loop -----------
+
+
+def test_pipelined_streaming_parity_vs_periodic_loop(tmp_path):
+    """The same gang arrivals through (a) KBT_PIPELINE + streaming
+    micro-cycles, (b) KBT_PIPELINE periodic full cycles, and (c) the
+    plain synchronous periodic loop must place bind-for-bind
+    identically -- overlap buys wall-clock, never different binds."""
+    gangs = [(f"g{i}", 2 + (i % 3)) for i in range(5)]
+
+    def run(pipelined: bool, streaming: bool) -> tuple[dict, Scheduler]:
+        pipeline.reset()
+        if pipelined:
+            os.environ[pipeline.ENV] = "1"
+        else:
+            os.environ.pop(pipeline.ENV, None)
+        store = ClusterStore()
+        seed_cluster(store, nodes=6)
+        _, sched = make_scheduler(
+            store, tmp_path, streaming=streaming,
+            period=0.25 if streaming else 0.02,
+        )
+        stop = threading.Event()
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            for name, members in gangs:
+                arrive_gang(store, name, members)
+                time.sleep(0.002)
+            wait_until(lambda: all_bound(store), what="all gangs bound")
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        assert pipeline.fence.degraded_reason is None
+        return placements(store), sched
+
+    pipe_stream, stream_sched = run(pipelined=True, streaming=True)
+    assert stream_sched.micro_cycles_run > 0, "streaming run never took the micro path"
+    pipe_full, _ = run(pipelined=True, streaming=False)
+    assert pipeline.fence._dispatch_s > 0.0, (
+        "the pipelined periodic run never deferred a dispatch"
+    )
+    sync_full, _ = run(pipelined=False, streaming=False)
+    assert pipe_full == sync_full, "pipelined cycles changed placements"
+    assert pipe_stream == sync_full, "pipelined streaming changed placements"
+
+
+# -- crash consistency: killed inside the deferred dispatch -------------------
+
+
+class _LeaderKilled(BaseException):
+    """SIGKILL stand-in: BaseException so no retry/resync ladder can
+    'survive' it -- the dispatch dies exactly where a killed process
+    would (same device as the streaming crash e2e)."""
+
+
+class DyingBinder(StoreBinder):
+    def __init__(self, store, die_after: int) -> None:
+        super().__init__(store)
+        self.left = die_after
+
+    def bind(self, pod, hostname: str) -> None:
+        if self.left <= 0:
+            raise _LeaderKilled()
+        self.left -= 1
+        super().bind(pod, hostname)
+
+
+def test_chaos_leader_killed_mid_deferred_dispatch_journal_reconciles(tmp_path):
+    """The leader dies inside cycle N's deferred replay/dispatch (after
+    journal appends, after some store writes landed). The PR-3 journal
+    holds the in-flight suffix; a standby's reconciliation plus one
+    ordinary synchronous cycle converge to the uninterrupted twin's
+    placements: zero lost, zero duplicate."""
+    total = 12  # 2 gangs x 6
+
+    # uninterrupted twin: plain synchronous cycle over the full arrival set
+    twin = ClusterStore()
+    seed_cluster(twin)
+    for g in range(2):
+        arrive_gang(twin, f"g{g}", members=6)
+    _, sched_t = make_scheduler(twin, tmp_path)
+    sched_t.run_once()
+    expected = placements(twin)
+    assert all(expected.values()) and len(expected) == total
+
+    # the real run: pipelined, the binder dies after 4 binds. The cache
+    # has no writer pool, so the deferred closure runs at submission and
+    # carries the death in its future -- close_session's join re-raises
+    # it on the scheduler thread, exactly where a fence join would.
+    os.environ[pipeline.ENV] = "1"
+    pipeline.reset()
+    store = ClusterStore()
+    seed_cluster(store)
+    bind_counts = count_bind_events(store)
+    journal = WriteIntentJournal(str(tmp_path / "leader.wal"))
+    _, sched = make_scheduler(
+        store, tmp_path,
+        journal=journal, binder=DyingBinder(store, die_after=4),
+    )
+    for g in range(2):
+        arrive_gang(store, f"g{g}", members=6)
+    with pytest.raises(_LeaderKilled):
+        sched.run_once()
+    landed = {k: v for k, v in placements(store).items() if v}
+    assert 0 < len(landed) < total, "kill must land mid-dispatch"
+    orphans = WriteIntentJournal.replay(journal.path).orphans
+    assert orphans, "journal must hold the in-flight suffix"
+
+    # standby: reconcile the journal, then one synchronous full cycle
+    pipeline.reset()
+    os.environ.pop(pipeline.ENV, None)
+    standby_journal = WriteIntentJournal(str(tmp_path / "leader.wal"))
+    report = reconcile_journal(standby_journal, store)
+    assert report.redispatched == len(orphans)
+    assert report.rolled_back == 0
+    _, sched_b = make_scheduler(store, tmp_path)
+    sched_b.run_once()
+
+    assert placements(store) == expected, "standby must converge to the twin"
+    assert all(n == 1 for n in bind_counts.values()), f"duplicate binds: {bind_counts}"
+    assert set(bind_counts) == set(expected), "lost binds"
+    standby_journal.close()

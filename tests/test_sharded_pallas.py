@@ -321,3 +321,99 @@ def test_mesh_pallas_fault_degrades_to_sharded_xla():
     finally:
         faults.registry.reset()
         faults.solver_ladder.reset()
+
+
+# -- K-deep batched exchange (ISSUE 13) -----------------------------------
+
+
+@pytest.mark.parametrize("n_devices,k", [(2, 2), (4, 4), (8, 4)])
+def test_batched_exchange_matches_xla_twin(n_devices, k):
+    """The speculative K-deep exchange (one all-gather per K gang
+    iterations, owner-shard validation replaying only invalidated
+    iterations) must agree with the XLA twin on every assignment and
+    actually commit iterations from batches."""
+    a = f32_arrays(synthetic(120, 24, seed=3))
+    ref = solve_allocate_state(a, None, enable_drf=True, enable_proportion=True)
+    sp = ShardedPallasSolver(
+        a, make_mesh(n_devices), enable_drf=True, enable_proportion=True,
+        exchange_batch=k,
+    )
+    got = sp.solve(None)
+    assert_assignment_equal(ref, got, ctx=f"mesh {n_devices} K={k}")
+    assert sp.batched_iters > 0, "no gang iteration committed from a batch"
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_batched_exchange_multi_queue(n_devices):
+    a = f32_arrays(multi_queue(96, 16, n_queues=3, tasks_per_job=6, seed=7))
+    ref = solve_allocate_state(a, None, enable_drf=True, enable_proportion=True)
+    sp = ShardedPallasSolver(
+        a, make_mesh(n_devices), enable_drf=True, enable_proportion=True,
+        exchange_batch=4,
+    )
+    got = sp.solve(None)
+    assert_assignment_equal(ref, got, ctx=f"mq mesh {n_devices} K=4")
+    assert sp.batched_iters > 0
+
+
+def test_batched_exchange_pause_resume_through_action():
+    """KBT_PIPELINE + KBT_EXCHANGE_BATCH through the real action routing,
+    including the segmented pod-affinity pause/resume hybrid: binds must
+    match the serial path, and the action must account the amortized
+    iterations (the bench rows read the same counter)."""
+    from kube_batch_tpu import pipeline
+    from kube_batch_tpu.actions.xla_allocate import XlaAllocateAction
+
+    saved = {k: os.environ.get(k) for k in ("KBT_PIPELINE", "KBT_EXCHANGE_BATCH")}
+    os.environ["KBT_PIPELINE"] = "1"
+    os.environ["KBT_EXCHANGE_BATCH"] = "4"
+    pipeline.reset()
+    try:
+        cache = FakeCache(_pod_affinity_cluster())
+        ssn = open_session(
+            cache,
+            parse_scheduler_conf(DEFAULT_TIERS_YAML).tiers,
+            {"xla_allocate": {"mesh": "cpu:4"}},
+        )
+        action = XlaAllocateAction(dtype=np.float32)
+        action.execute(ssn)
+        close_session(ssn)
+        assert action.last_mesh_size == 4
+        assert action.last_solver_tier == "mesh_pallas"
+        assert action.last_batched_iters > 0
+        serial = run_serial(_pod_affinity_cluster)
+        assert dict(cache.binder.binds) == serial and len(serial) == 12
+    finally:
+        pipeline.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_default_exchange_batch_env():
+    """K defaults to 1 (no speculation) outside pipelined mode; inside,
+    KBT_EXCHANGE_BATCH with a sane default and a clamp."""
+    from kube_batch_tpu import pipeline
+    from kube_batch_tpu.parallel.sharded_pallas import _default_exchange_batch
+
+    saved = {k: os.environ.get(k) for k in ("KBT_PIPELINE", "KBT_EXCHANGE_BATCH")}
+    try:
+        os.environ.pop("KBT_PIPELINE", None)
+        os.environ["KBT_EXCHANGE_BATCH"] = "8"
+        assert _default_exchange_batch() == 1, "K>1 must require KBT_PIPELINE"
+        os.environ["KBT_PIPELINE"] = "1"
+        assert _default_exchange_batch() == 8
+        os.environ.pop("KBT_EXCHANGE_BATCH", None)
+        assert _default_exchange_batch() == 4
+        os.environ["KBT_EXCHANGE_BATCH"] = "200"
+        assert _default_exchange_batch() == 64
+        os.environ["KBT_EXCHANGE_BATCH"] = "banana"
+        assert _default_exchange_batch() == 4
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
