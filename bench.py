@@ -876,6 +876,12 @@ def main() -> None:
             # a sticky degradation left over from an earlier row would
             # silently serialize this one and invalidate its column
             pipeline.reset()
+        # per-row HBM watermark: the arena's high-water mark is monotonic
+        # across uploads, so zero it here or a 400k row would pollute the
+        # small rows after it
+        arena = get_action("xla_allocate")._arena
+        arena.hbm_watermark_bytes = 0
+        overlap_fraction = None
         try:
             (xla_s, binds, t), times, compiles = timed(
                 make_cluster, "xla_allocate", warm=True, repeats=sessions,
@@ -889,6 +895,13 @@ def main() -> None:
                 assert pipeline.fence.degraded_reason is None, (
                     f"{name}: pipeline degraded mid-row: "
                     f"{pipeline.fence.degraded_reason}"
+                )
+                # capture the measured overlap BEFORE the finally's
+                # pipeline.reset() clears it: join-window vs
+                # dispatch-window intersection, not a wall-clock guess
+                overlap_fraction = pipeline.fence.last_overlap_fraction
+                assert overlap_fraction is not None, (
+                    f"{name}: KBT_PIPELINE row recorded no overlap sample"
                 )
         finally:
             for k, v in saved.items():
@@ -914,6 +927,13 @@ def main() -> None:
             entry["p99_s"] = round(percentile(times, 99), 4)
         for k, v in t.items():
             entry[k] = round(v, 4)
+        # Device-phase columns (ISSUE 14): HBM high-water mark of the
+        # arena's resident slabs (both banks count in pipelined mode),
+        # and — pipelined rows only — the measured overlap fraction.
+        if arena.hbm_watermark_bytes:
+            entry["arena_hbm_watermark_bytes"] = int(arena.hbm_watermark_bytes)
+        if overlap_fraction is not None:
+            entry["pipeline_overlap_fraction"] = round(overlap_fraction, 4)
         # Phase breakdown on every row (ISSUE 11): where the best run's
         # wall time went — encode vs solve vs dispatch (replay + write
         # submit) — from the action's own perf_counter bookkeeping, so

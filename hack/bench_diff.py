@@ -33,6 +33,15 @@ Rows present on only one side are reported (``added``/``removed``) but
 only ``removed`` counts as a finding: a vanished row is a silently
 narrowed bench. Improvements are listed informationally.
 
+Device-phase and fleet telemetry columns (``solve_device_s``,
+``pipeline_overlap_fraction``, ``arena_hbm_watermark_bytes``, and any
+``fleet_*`` column) are understood but NEVER flagged: solve_device_s is
+a sub-phase of ``solve_s`` (already covered by the latency gate), the
+overlap fraction and HBM watermark are descriptive telemetry whose
+"right" value is config-dependent, and fleet columns are aggregator
+state rather than per-row latency. Changes in them print as ``[info]``
+lines and do not affect the exit code, even under ``--strict``.
+
 ``--json`` emits one machine-readable summary line; ``--strict`` exits
 nonzero when any finding fired (default exit is 0 — informational).
 """
@@ -48,6 +57,16 @@ import sys
 _LATENCY_KEYS = ("p50_s", "xla_s")
 _PARITY_KEYS = ("placements_equal_serial", "placements_equal_full_cycle")
 _COMPILE_KEYS = ("measured_compiles", "warm_encode_compiles")
+# never-flagged telemetry columns (see module docstring)
+_INFO_KEYS = (
+    "solve_device_s",
+    "pipeline_overlap_fraction",
+    "arena_hbm_watermark_bytes",
+)
+
+
+def _is_info_key(key: str) -> bool:
+    return key in _INFO_KEYS or key.startswith("fleet_")
 
 
 def _rows_from_obj(obj):
@@ -121,6 +140,7 @@ def _latency(row: dict):
 def diff_rows(old: dict, new: dict, threshold: float) -> dict:
     findings = []
     improvements = []
+    info = []
     added = sorted(set(new) - set(old))
     removed = sorted(set(old) - set(new))
     for name in removed:
@@ -163,6 +183,13 @@ def diff_rows(old: dict, new: dict, threshold: float) -> dict:
                     "msg": f"{name}: {k} {oc if oc is not None else 0} "
                            f"-> {nc} (measured repeats started compiling)",
                 })
+        for k in sorted(set(o) | set(n)):
+            if not _is_info_key(k):
+                continue
+            oi, ni = o.get(k), n.get(k)
+            if oi == ni:
+                continue
+            info.append(f"{name}: {k} {oi} -> {ni}")
     return {
         "rows_old": len(old),
         "rows_new": len(new),
@@ -170,6 +197,7 @@ def diff_rows(old: dict, new: dict, threshold: float) -> dict:
         "removed": removed,
         "findings": findings,
         "improvements": improvements,
+        "info": info,
         "ok": not findings,
     }
 
@@ -197,6 +225,8 @@ def main(argv=None) -> int:
         print(f"bench_diff: [{f['kind']}] {f['msg']}")
     for line in summary["improvements"]:
         print(f"bench_diff: [improved] {line}")
+    for line in summary["info"]:
+        print(f"bench_diff: [info] {line}")
     for name in summary["added"]:
         print(f"bench_diff: [added] {name}: new row in NEW")
     print(

@@ -510,6 +510,51 @@ def run_explain_gate(env: dict) -> dict:
     }
 
 
+def run_fleet_gate(env: dict) -> dict:
+    """Default gate: the fleet-aggregation self-check
+    (python -m kube_batch_tpu.obs.fleet --json) at BOTH 2 and 4
+    loopback shards. Per-shard SLO sketches served over live HTTP
+    observatories, scraped and merged by the aggregator: merged
+    p50/p90/p99 must land within the sketch's declared relative-error
+    bound of the pooled-raw nearest-rank quantiles, with exactly-once
+    binds and an fsck-clean store asserted in-row."""
+    import json
+
+    env = dict(env)
+    # overrides armed in the shell would skew the smoke (it arms
+    # KBT_FLEET itself and runs a federated world)
+    for var in ("KBT_FLEET", "KBT_TRACE", "KBT_FEDERATION",
+                "KBT_SHARD_KEY", "KBT_FLIGHT_RECORDER"):
+        env.pop(var, None)
+    out: dict = {"ok": True}
+    for shards in (2, 4):
+        res = subprocess.run(
+            [sys.executable, "-m", "kube_batch_tpu.obs.fleet", "--json",
+             "--shards", str(shards)],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        summary: dict = {}
+        try:
+            summary = json.loads(res.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            print(f"verify: fleet obs smoke ({shards} shards) produced "
+                  "no parseable summary")
+            print(res.stdout, res.stderr, sep="\n")
+        ok = res.returncode == 0 and summary.get("ok", False)
+        if not ok:
+            print(f"verify: fleet obs smoke FAILED at {shards} shards "
+                  f"({summary})")
+            out["ok"] = False
+        out[f"shards_{shards}"] = {
+            "ok": ok,
+            "max_rel_err": summary.get("max_rel_err"),
+            "rel_err_bound": summary.get("rel_err_bound"),
+            "exactly_once": summary.get("exactly_once"),
+            "fsck_violations": len(summary.get("fsck_violations") or []),
+        }
+    return out
+
+
 def run_bench_diff_gate(old: str, new: str) -> dict:
     """--bench-diff OLD NEW: hack/bench_diff.py in --strict mode — a
     >15% p50 regression, a parity flip, a compile-budget change or a
@@ -873,6 +918,14 @@ def main(argv: list[str] | None = None) -> int:
     # kube_batch_tpu.obs.explain). Part of the default gate set.
     gates["explain_smoke"] = run_explain_gate(env)
     if not gates["explain_smoke"]["ok"]:
+        failed = True
+
+    # 7c-ter. fleet observability smoke: per-shard sketches scraped and
+    # merged over live loopback HTTP at 2 AND 4 shards, merged
+    # quantiles within the sketch's error bound of pooled raw (python
+    # -m kube_batch_tpu.obs.fleet). Part of the default gate set.
+    gates["fleet_obs_smoke"] = run_fleet_gate(env)
+    if not gates["fleet_obs_smoke"]["ok"]:
         failed = True
 
     # 7d. --federation: the wire-path smoke + the seeded two-scheduler

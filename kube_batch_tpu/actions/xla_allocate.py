@@ -341,6 +341,13 @@ class XlaAllocateAction(Action):
                     state = solve_fn(s)
 
                 result = result_of(state)
+                # Device fencepost (device-phase telemetry): block until
+                # the solver's outputs have materialized ON DEVICE before
+                # the host transfers below — solve_device_s is then a
+                # device-event-measured phase boundary, not a wall-clock
+                # figure with transfer time folded in.
+                jax.block_until_ready(result.assign_pos)
+                t_solve_device = _time.perf_counter() - t0
                 # all three result vectors come off-device here: the transfer is
                 # part of the solve's device round-trip, not of the replay
                 assign_pos = np.asarray(result.assign_pos)
@@ -388,7 +395,11 @@ class XlaAllocateAction(Action):
             # with zero cache mutation.
             budget.check("dispatch barrier", inject=True)
 
-        timings: dict[str, float] = {"encode_s": t_encode, "solve_s": t_solve}
+        timings: dict[str, float] = {
+            "encode_s": t_encode,
+            "solve_s": t_solve,
+            "solve_device_s": t_solve_device,
+        }
         self.last_timings = timings
 
         def _post_solve(parent=None) -> float:
@@ -430,7 +441,12 @@ class XlaAllocateAction(Action):
             ctx = obs.current()  # pool threads don't inherit the contextvar
 
             def _deferred() -> None:
-                _pipeline.fence.record_dispatch_seconds(_post_solve(parent=ctx))
+                # stamp the dispatch window for the measured overlap
+                # fraction: [d0, d1] intersected with the consumer's
+                # join window is the serialized share
+                d0 = _time.perf_counter()
+                _post_solve(parent=ctx)
+                _pipeline.fence.record_dispatch_window(d0, _time.perf_counter())
 
             fut = _pipeline.submit(ssn.cache, _deferred)
             ssn.deferred_dispatch = fut

@@ -1,4 +1,4 @@
-"""A3 — registry-consistency analyzer (KBT-R001..R011).
+"""A3 — registry-consistency analyzer (KBT-R001..R012).
 
 Three registries grew to dozens of names across PR 1-3, each previously
 checked only by grep and luck:
@@ -42,6 +42,12 @@ checked only by grep and luck:
   must be a declared metric (R011) — a helpless or unlisted metric is
   a series Prometheus scrapes without ``# HELP``/``# TYPE`` or never
   sees at all.
+- **SLO kind registry**: every kind in ``obs.SLOAccountant.KINDS`` must
+  have a gauge entry in BOTH ``metrics._SLO_GAUGES`` (per-shard publish)
+  and ``metrics._FLEET_SLO_GAUGES`` (fleet aggregation), and every key
+  of those dicts must be a declared kind (R012) — a kind without a
+  gauge entry silently never publishes its quantiles, and a gauge keyed
+  to no kind is a family the exposition carries but nothing ever sets.
 """
 
 from __future__ import annotations
@@ -558,6 +564,91 @@ def _check_metric_help(files: list[SourceFile], findings: list[Finding]) -> None
             )
 
 
+# -- SLO kind registry (R012) ------------------------------------------------
+
+_SLO_GAUGE_MAPS = ("_SLO_GAUGES", "_FLEET_SLO_GAUGES")
+
+
+def _slo_kinds(files: list[SourceFile]) -> dict[str, int]:
+    """kind -> lineno of the ``KINDS = (...)`` tuple inside the
+    SLOAccountant class body in obs/__init__.py."""
+    for sf in files:
+        if sf.path != OBS_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.ClassDef) and node.name == "SLOAccountant"):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == "KINDS":
+                            v = stmt.value
+                            if isinstance(v, (ast.Tuple, ast.List)):
+                                return {
+                                    e.value: e.lineno
+                                    for e in v.elts
+                                    if isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                }
+    return {}
+
+
+def _slo_gauge_keys(files: list[SourceFile], map_name: str) -> dict[str, int]:
+    """key -> lineno for the ``map_name = {...}`` dict literal at module
+    top level of metrics/__init__.py."""
+    for sf in files:
+        if sf.path != METRICS_MODULE:
+            continue
+        mod = sf.tree
+        if not isinstance(mod, ast.Module):
+            continue
+        for node in mod.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == map_name:
+                        return {
+                            k.value: k.lineno
+                            for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                        }
+    return {}
+
+
+def _check_slo_kind_registry(
+    files: list[SourceFile], findings: list[Finding]
+) -> None:
+    kinds = _slo_kinds(files)
+    if not kinds:
+        return
+    for map_name in _SLO_GAUGE_MAPS:
+        keys = _slo_gauge_keys(files, map_name)
+        if not keys:
+            continue
+        for kind, lineno in sorted(kinds.items()):
+            if kind not in keys:
+                findings.append(
+                    Finding(
+                        OBS_MODULE, lineno, "KBT-R012",
+                        f"SLO kind {kind!r} has no gauge entry in "
+                        f"metrics.{map_name} — its quantiles are tracked "
+                        "but never published to the exposition",
+                        symbol=f"slo_kind:{kind}",
+                    )
+                )
+        for key, lineno in sorted(keys.items()):
+            if key not in kinds:
+                findings.append(
+                    Finding(
+                        METRICS_MODULE, lineno, "KBT-R012",
+                        f"metrics.{map_name} key {key!r} is not a kind in "
+                        "obs.SLOAccountant.KINDS — the gauge family is "
+                        "registered but nothing ever sets it",
+                        symbol=f"slo_kind:{key}",
+                    )
+                )
+
+
 # -- env knobs ---------------------------------------------------------------
 
 
@@ -671,4 +762,5 @@ def analyze(
     _check_debug_endpoints(files, repo, runbook, findings)
     _check_metric_help(files, findings)
     _check_env(files, repo, runbook, findings)
+    _check_slo_kind_registry(files, findings)
     return findings

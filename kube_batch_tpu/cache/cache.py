@@ -1139,16 +1139,25 @@ class SchedulerCache:
                     )
                     gspan.set_attr("outcome", "won" if conflicts else "clean")
                     gspan.set_attr("conflicts", conflicts)
-                    metrics.register_federation_conflict("won" if conflicts else "clean")
+                    metrics.register_federation_conflict(
+                        "won" if conflicts else "clean",
+                        exemplar=gspan.trace_id,
+                    )
                     for _pod, _hostname, _task, seq in entries:
                         self._journal_confirm(seq)
                     return
                 except StaleWrite as e:
                     conflicts += 1
+                    # per-node conflict accounting: the fleet heatmap
+                    # ranks contended nodes from deltas of this counter
+                    for node in sorted({h for _ns, _n, h in bindings}):
+                        metrics.register_federation_node_conflict(node)
                     if conflicts > self._conflict_max_retries:
                         gspan.set_attr("outcome", "lost")
                         gspan.set_attr("conflicts", conflicts)
-                        metrics.register_federation_conflict("lost")
+                        metrics.register_federation_conflict(
+                            "lost", exemplar=gspan.trace_id
+                        )
                         log.errorf(
                             "bind of %s lost the conflict after %d retr%s (%s); "
                             "accepting store truth and resyncing the gang",
@@ -1159,7 +1168,9 @@ class SchedulerCache:
                             self.resync_task(task)
                         return
                     gspan.event("conflict", retry=conflicts, error=str(e))
-                    metrics.register_federation_conflict("retried")
+                    metrics.register_federation_conflict(
+                        "retried", exemplar=gspan.trace_id
+                    )
                     metrics.register_bind_retry()
                     log.warningf(
                         "bind of %s conflicted (%s), retry %d/%d with fresh version",

@@ -117,6 +117,13 @@ class SchedulerConfiguration:
     equivalent, empty defers to it). Hot-reloadable like ``trace``::
 
         explain: on
+
+    and ``fleet``: comma-separated peer base URLs for fleet-wide SLO
+    aggregation (kube_batch_tpu.obs.fleet; env KBT_FLEET is the
+    process-wide equivalent, empty defers to it). Hot-reloadable like
+    ``trace`` — a conf push turns a live scheduler into an aggregator::
+
+        fleet: "http://shard0:8080, http://shard1:8080"
     """
 
     actions: str = ""
@@ -126,6 +133,7 @@ class SchedulerConfiguration:
     streaming: bool = False
     trace: str = ""
     explain: str = ""
+    fleet: str = ""
 
 
 # Default conf (reference util.go:31-42).
@@ -160,6 +168,7 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
         streaming=bool(data.get("streaming", False)),
         trace=str(data.get("trace") if data.get("trace") is not None else ""),
         explain=str(data.get("explain") if data.get("explain") is not None else ""),
+        fleet=str(data.get("fleet") if data.get("fleet") is not None else ""),
     )
     for action_name, args in (data.get("actionArguments") or {}).items():
         conf.action_arguments[str(action_name)] = {

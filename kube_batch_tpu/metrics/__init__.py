@@ -10,6 +10,7 @@ renders Prometheus text exposition for the server's /metrics endpoint.
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import Iterable, Optional
 
@@ -17,6 +18,19 @@ from typing import Iterable, Optional
 # (metrics.go:49-72). Values recorded in seconds.
 E2E_BUCKETS = tuple(0.005 * 2**k for k in range(12))
 FINE_BUCKETS = tuple(5e-6 * 2**k for k in range(18))
+
+# OpenMetrics exemplars: when armed, observations that pass an
+# ``exemplar=`` trace id keep the latest one per label set and the
+# exposition appends ``# {trace_id="..."} value`` to the matching
+# bucket/sample line — a p99 outlier on /metrics then links straight to
+# its flight-recorder trace. Off by default: exemplar storage is the
+# only cost, and the golden exposition stays byte-stable.
+EXEMPLARS_ENV = "KBT_METRICS_EXEMPLARS"
+_EXEMPLAR_OFF = ("", "0", "false", "off", "no")
+
+
+def exemplars_enabled() -> bool:
+    return os.environ.get(EXEMPLARS_ENV, "").strip().lower() not in _EXEMPLAR_OFF
 
 
 class Histogram:
@@ -29,12 +43,19 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         # label tuple -> [counts per bucket + overflow, sum, total]
         self._series: dict[tuple, list] = {}
+        # label tuple -> (trace_id, value) — latest exemplar per series
+        self._exemplars: dict[tuple, tuple[str, float]] = {}
         self._lock = threading.Lock()
 
     def _key(self, labels: Optional[dict[str, str]]) -> tuple:
         return tuple(sorted((labels or {}).items()))
 
-    def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+    def observe(
+        self,
+        value: float,
+        labels: Optional[dict[str, str]] = None,
+        exemplar: str | None = None,
+    ) -> None:
         key = self._key(labels)
         with self._lock:
             series = self._series.get(key)
@@ -44,6 +65,8 @@ class Histogram:
             counts, _, _ = series
             series[1] += value
             series[2] += 1
+            if exemplar and exemplars_enabled():
+                self._exemplars[key] = (exemplar, value)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
@@ -148,17 +171,30 @@ class Counter:
         self.name = name
         self.help = help_text
         self._values: dict[tuple, float] = {}
+        self._exemplars: dict[tuple, tuple[str, float]] = {}
         self._lock = threading.Lock()
 
-    def inc(self, labels: Optional[dict[str, str]] = None, by: float = 1.0) -> None:
+    def inc(
+        self,
+        labels: Optional[dict[str, str]] = None,
+        by: float = 1.0,
+        exemplar: str | None = None,
+    ) -> None:
         key = tuple(sorted((labels or {}).items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + by
+            if exemplar and exemplars_enabled():
+                self._exemplars[key] = (exemplar, by)
 
     def value(self, labels: Optional[dict[str, str]] = None) -> float:
         key = tuple(sorted((labels or {}).items()))
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def samples(self) -> dict[tuple, float]:
+        """All label sets with their values (the fleet scrape unit)."""
+        with self._lock:
+            return dict(self._values)
 
 
 class Gauge:
@@ -177,6 +213,27 @@ class Gauge:
         key = tuple(sorted((labels or {}).items()))
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def samples(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def drop_labels(self, **match: str) -> int:
+        """Remove every label set matching all given label=value pairs
+        (SLO queue eviction must drop the gauge series too, or the
+        cardinality bound would leak through the exposition)."""
+        with self._lock:
+            dead = [
+                k for k in self._values
+                if all(dict(k).get(a) == b for a, b in match.items())
+            ]
+            for k in dead:
+                del self._values[k]
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
 
 
 _SUBSYSTEM = "kube_batch_tpu"
@@ -304,6 +361,11 @@ federation_conflicts = Counter(
     "Optimistic-concurrency dispatch outcomes, by outcome "
     "(clean/won/retried/lost)",
 )
+federation_node_conflicts = Counter(
+    f"{_SUBSYSTEM}_federation_node_conflicts_total",
+    "Optimistic-concurrency bind conflicts attributed to the contended "
+    "node, by node (the fleet heatmap's delta source)",
+)
 bind_retries = Counter(
     f"{_SUBSYSTEM}_bind_retries_total",
     "Gang bind transactions re-sent with a refreshed snapshot version "
@@ -360,6 +422,61 @@ slo_queue_wait = Gauge(
     "(labels: queue, quantile=p50/p90/p99)",
 )
 _SLO_GAUGES = {"time_to_bind": slo_time_to_bind, "queue_wait": slo_queue_wait}
+slo_evicted_queues = Counter(
+    f"{_SUBSYSTEM}_slo_evicted_queues_total",
+    "Queues evicted from the SLO accountant's LRU cardinality bound "
+    "(a tenant-name churn storm shows up here, not as unbounded labels)",
+)
+
+# -- fleet observatory (kube_batch_tpu.obs.fleet, KBT_FLEET) -----------------
+# Cluster-wide rollups an aggregator computes by scraping peer shards'
+# /debug/slo?raw=1 sketches and key counters, then merging — the only
+# composable way to a fleet p99 (averaging per-shard percentiles is
+# statistically wrong).
+fleet_slo_time_to_bind = Gauge(
+    f"{_SUBSYSTEM}_fleet_slo_time_to_bind_seconds",
+    "Cluster-wide sliding-window time-to-bind quantiles merged from all "
+    "scraped shards' sketches (labels: queue, quantile=p50/p90/p99)",
+)
+fleet_slo_queue_wait = Gauge(
+    f"{_SUBSYSTEM}_fleet_slo_queue_wait_seconds",
+    "Cluster-wide sliding-window queue-wait quantiles merged from all "
+    "scraped shards' sketches (labels: queue, quantile=p50/p90/p99)",
+)
+_FLEET_SLO_GAUGES = {
+    "time_to_bind": fleet_slo_time_to_bind,
+    "queue_wait": fleet_slo_queue_wait,
+}
+fleet_node_conflicts = Gauge(
+    f"{_SUBSYSTEM}_fleet_node_conflicts",
+    "Per-node bind-conflict heatmap: top-K contended nodes by conflict "
+    "delta since the previous fleet scrape, summed across shards (by node)",
+)
+fleet_backlog = Gauge(
+    f"{_SUBSYSTEM}_fleet_backlog_pods",
+    "Aggregate arrived-but-unbound backlog summed across scraped shards",
+)
+fleet_pods_per_second = Gauge(
+    f"{_SUBSYSTEM}_fleet_pods_per_second",
+    "Aggregate bind throughput across scraped shards, from bind-count "
+    "deltas between fleet scrapes",
+)
+fleet_shards_scraped = Gauge(
+    f"{_SUBSYSTEM}_fleet_shards_scraped",
+    "Peer shards the fleet aggregator reached on its last scrape "
+    "(a drop below the configured peer count means a dark shard)",
+)
+
+# -- device-phase telemetry (arena HBM accounting, ops/encode_cache) ---------
+arena_hbm_bytes = Gauge(
+    f"{_SUBSYSTEM}_arena_hbm_bytes",
+    "Device bytes currently held by the tensor arena, by slab",
+)
+arena_hbm_watermark = Gauge(
+    f"{_SUBSYSTEM}_arena_hbm_watermark_bytes",
+    "High watermark of total device bytes held by the tensor arena "
+    "since process start (the bench's HBM column)",
+)
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -469,8 +586,8 @@ def set_encode_warm_fraction(fraction: float) -> None:
     encode_warm_fraction.set(fraction)
 
 
-def observe_time_to_bind(seconds: float) -> None:
-    time_to_bind.observe(seconds)
+def observe_time_to_bind(seconds: float, exemplar: str | None = None) -> None:
+    time_to_bind.observe(seconds, exemplar=exemplar)
 
 
 def register_micro_cycle(outcome: str) -> None:
@@ -481,8 +598,12 @@ def set_streaming_backlog(n: int) -> None:
     streaming_backlog.set(n)
 
 
-def register_federation_conflict(outcome: str) -> None:
-    federation_conflicts.inc({"outcome": outcome})
+def register_federation_conflict(outcome: str, exemplar: str | None = None) -> None:
+    federation_conflicts.inc({"outcome": outcome}, exemplar=exemplar)
+
+
+def register_federation_node_conflict(node: str, n: int = 1) -> None:
+    federation_node_conflicts.inc({"node": node}, by=n)
 
 
 def register_bind_retry() -> None:
@@ -506,6 +627,50 @@ def set_slo_quantile(kind: str, queue: str, quantile: str, value: float) -> None
     gauge = _SLO_GAUGES.get(kind)
     if gauge is not None:
         gauge.set(value, {"queue": queue, "quantile": quantile})
+
+
+def register_slo_evicted_queue() -> None:
+    slo_evicted_queues.inc()
+
+
+def drop_slo_queue(queue: str) -> None:
+    """Remove an evicted queue's label sets from both slo gauges."""
+    for gauge in _SLO_GAUGES.values():
+        gauge.drop_labels(queue=queue)
+
+
+def set_fleet_slo_quantile(kind: str, queue: str, quantile: str, value: float) -> None:
+    gauge = _FLEET_SLO_GAUGES.get(kind)
+    if gauge is not None:
+        gauge.set(value, {"queue": queue, "quantile": quantile})
+
+
+def set_fleet_node_heatmap(deltas: dict[str, float]) -> None:
+    """Replace the per-node conflict heatmap wholesale (top-K only —
+    stale nodes must drop out, not linger at their old value)."""
+    fleet_node_conflicts.clear()
+    for node, value in deltas.items():
+        fleet_node_conflicts.set(value, {"node": node})
+
+
+def set_fleet_backlog(n: float) -> None:
+    fleet_backlog.set(n)
+
+
+def set_fleet_pods_per_second(value: float) -> None:
+    fleet_pods_per_second.set(value)
+
+
+def set_fleet_shards_scraped(n: int) -> None:
+    fleet_shards_scraped.set(n)
+
+
+def set_arena_hbm_bytes(slab: str, nbytes: float) -> None:
+    arena_hbm_bytes.set(nbytes, {"slab": slab})
+
+
+def set_arena_hbm_watermark(nbytes: float) -> None:
+    arena_hbm_watermark.set(nbytes)
 
 
 def set_pipeline_overlap_fraction(fraction: float) -> None:
@@ -533,6 +698,21 @@ def _escape_label_value(value) -> str:
     )
 
 
+def _exemplar_of(metric, key) -> tuple[str, float] | None:
+    """OpenMetrics exemplar annotation for one series as
+    ``(suffix, value)``, or None. Only rendered while
+    KBT_METRICS_EXEMPLARS is on (storage is gated the same way, so the
+    golden exposition never sees a stale one)."""
+    if not exemplars_enabled():
+        return None
+    with metric._lock:
+        ex = metric._exemplars.get(key)
+    if ex is None:
+        return None
+    trace_id, value = ex
+    return (f' # {{trace_id="{_escape_label_value(trace_id)}"}} {value}', value)
+
+
 def _render_family(metric) -> list[str]:
     lines = [f"# HELP {metric.name} {metric.help}"]
     if isinstance(metric, Histogram):
@@ -543,29 +723,40 @@ def _render_family(metric) -> list[str]:
             snap = metric.snapshot(labels if key else None)
             prefix = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
             sep = "," if prefix else ""
+            ex = _exemplar_of(metric, key)
+            ex_suffix, ex_value = ex if ex else ("", None)
             for boundary, cum in snap["buckets"].items():
+                mark = ex_suffix if ex_value is not None and ex_value <= boundary else ""
+                if mark:
+                    ex_value = None  # exemplar rides its lowest containing bucket
                 lines.append(
-                    f'{metric.name}_bucket{{{prefix}{sep}le="{boundary}"}} {cum}'
+                    f'{metric.name}_bucket{{{prefix}{sep}le="{boundary}"}} {cum}{mark}'
                 )
-            lines.append(f'{metric.name}_bucket{{{prefix}{sep}le="+Inf"}} {snap["count"]}')
+            mark = ex_suffix if ex_value is not None else ""
+            lines.append(
+                f'{metric.name}_bucket{{{prefix}{sep}le="+Inf"}} {snap["count"]}{mark}'
+            )
             suffix = f"{{{prefix}}}" if prefix else ""
             lines.append(f"{metric.name}_sum{suffix} {snap['sum']}")
             lines.append(f"{metric.name}_count{suffix} {snap['count']}")
     else:
         kind = "counter" if isinstance(metric, Counter) else "gauge"
         lines.append(f"# TYPE {metric.name} {kind}")
-        with metric._lock:
-            items = dict(metric._values)
+        items = metric.samples()
         if not items:
             lines.append(f"{metric.name} 0")
         for key, value in items.items():
+            ex = ""
+            if kind == "counter":
+                found = _exemplar_of(metric, key)
+                ex = found[0] if found else ""
             if key:
                 label_str = ",".join(
                     f'{k}="{_escape_label_value(v)}"' for k, v in key
                 )
-                lines.append(f"{metric.name}{{{label_str}}} {value}")
+                lines.append(f"{metric.name}{{{label_str}}} {value}{ex}")
             else:
-                lines.append(f"{metric.name} {value}")
+                lines.append(f"{metric.name} {value}{ex}")
     return lines
 
 
@@ -602,6 +793,7 @@ def render_prometheus_text() -> str:
         micro_cycles,
         streaming_backlog,
         federation_conflicts,
+        federation_node_conflicts,
         bind_retries,
         store_backend_rtt,
         unschedulable_total,
@@ -611,6 +803,15 @@ def render_prometheus_text() -> str:
         pipeline_fence_wait_seconds,
         slo_time_to_bind,
         slo_queue_wait,
+        slo_evicted_queues,
+        fleet_slo_time_to_bind,
+        fleet_slo_queue_wait,
+        fleet_node_conflicts,
+        fleet_backlog,
+        fleet_pods_per_second,
+        fleet_shards_scraped,
+        arena_hbm_bytes,
+        arena_hbm_watermark,
     ]
     lines: list[str] = []
     for metric in families:

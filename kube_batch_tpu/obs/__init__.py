@@ -22,9 +22,13 @@ on which shard, at which solver tier, behind which conflict retry":
   hard-deadline abort, SIGTERM, and on demand via ``/debug/trace``.
 
 - **SLO accountant.** Sliding-window (``KBT_SLO_WINDOW_S``, default
-  300 s) p50/p90/p99 time-to-bind and queue-wait *per queue*, exposed
-  on ``/metrics`` (``kbt..._slo_*`` gauges) and ``/debug/slo`` — the
-  front-door input for ROADMAP item 1's admission lanes.
+  300 s) p50/p90/p99 time-to-bind and queue-wait *per queue*, kept in
+  mergeable DDSketch-style :class:`QuantileSketch` rings (relative
+  error ``alpha``, LRU-bounded queue cardinality), exposed on
+  ``/metrics`` (``kbt..._slo_*`` gauges) and ``/debug/slo`` (append
+  ``?raw=1`` for the serialized sketches) — the front-door input for
+  ROADMAP item 1's admission lanes and the merge unit obs/fleet rolls
+  up cluster-wide.
 
 Tracing is off by default and zero-allocation-cheap when off: every
 entry point checks one module bool and returns the shared no-op span
@@ -42,6 +46,7 @@ from __future__ import annotations
 import collections
 import contextvars
 import json
+import math
 import os
 import signal
 import tempfile
@@ -72,8 +77,10 @@ __all__ = [
     "annotate",
     "FlightRecorder",
     "recorder",
+    "QuantileSketch",
     "SLOAccountant",
     "slo",
+    "current_trace_id",
     "chrome_events",
     "export_jsonl",
     "export_chrome",
@@ -111,9 +118,9 @@ SPAN_NAMES = (
 )
 
 # Every /debug/* route server.py serves. Checked both directions by the
-# KBT-R analyzer (R009/R010) against server.py literals and the runbook
-# endpoint table.
-DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo", "/debug/explain")
+# KBT-R analyzer (R009/R010/R012) against server.py literals and the
+# runbook endpoint table.
+DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo", "/debug/explain", "/debug/fleet")
 
 # Wall/perf anchor pair: spans are stamped with the monotonic clock (so
 # durations survive NTP steps) and exported in wall-clock microseconds
@@ -282,6 +289,15 @@ def current():
     if not _enabled:
         return None
     return _current.get()
+
+
+def current_trace_id() -> str:
+    """The current span's trace id, or "" — the metric-exemplar hook
+    (metrics attach it to observations under KBT_METRICS_EXEMPLARS)."""
+    if not _enabled:
+        return ""
+    cur = _current.get()
+    return cur.trace_id if cur is not None else ""
 
 
 def current_headers() -> dict:
@@ -479,6 +495,164 @@ def export_chrome(spans: list[dict], path: str) -> str:
 
 _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 
+# Values at or below this collapse into the sketch's zero bucket (a
+# latency of < 1 ns is measurement noise, not signal).
+_SKETCH_MIN = 1e-9
+
+
+class QuantileSketch:
+    """DDSketch-style relative-error quantile sketch over a sliding
+    time window, built to MERGE: two shards' sketches combined with
+    :meth:`merge` are cell-for-cell identical to one sketch fed the
+    pooled sample stream (cell assignment is a pure function of the
+    observation's wall-clock time and value, given equal ``alpha`` and
+    ``slice_s`` — which :meth:`merge` asserts).
+
+    Geometry: bucket ``i = ceil(ln(v) / ln(gamma))`` with
+    ``gamma = (1 + alpha) / (1 - alpha)``; the bucket midpoint
+    ``2 * gamma^i / (gamma + 1)`` reconstructs any member value within
+    relative error ``alpha``. The window is a ring of ``slices`` time
+    buckets keyed by absolute wall-clock epoch (``int(t // slice_s)``)
+    so expiry drops whole slices and epochs line up across processes.
+    Not thread-safe; callers (SLOAccountant) hold their own lock."""
+
+    DEFAULT_ALPHA = 0.01
+    DEFAULT_SLICES = 12
+
+    __slots__ = ("alpha", "window_s", "slice_s", "_gamma", "_log_gamma", "_slices")
+
+    def __init__(
+        self,
+        alpha: float = DEFAULT_ALPHA,
+        window_s: float = 300.0,
+        slices: int = DEFAULT_SLICES,
+    ) -> None:
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self.window_s = float(window_s)
+        self.slice_s = self.window_s / max(1, int(slices))
+        self._gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._log_gamma = math.log(self._gamma)
+        # epoch -> [bucket -> count, zero_count, n, sum]
+        self._slices: dict[int, list] = {}
+
+    def bucket_of(self, v: float) -> int:
+        return math.ceil(math.log(v) / self._log_gamma)
+
+    def value_of(self, bucket: int) -> float:
+        return 2.0 * self._gamma ** bucket / (self._gamma + 1.0)
+
+    def add(self, v: float, t: float | None = None) -> None:
+        t = time.time() if t is None else t
+        epoch = int(t // self.slice_s)
+        sl = self._slices.get(epoch)
+        if sl is None:
+            sl = self._slices[epoch] = [{}, 0, 0, 0.0]
+        if v <= _SKETCH_MIN:
+            sl[1] += 1
+        else:
+            b = self.bucket_of(v)
+            sl[0][b] = sl[0].get(b, 0) + 1
+        sl[2] += 1
+        sl[3] += v
+
+    def trim(self, now: float | None = None) -> None:
+        """Drop slices whose entire span precedes the window horizon
+        (expiry slack: at most one slice length)."""
+        now = time.time() if now is None else now
+        horizon = now - self.window_s
+        for epoch in [
+            e for e in self._slices if (e + 1) * self.slice_s <= horizon
+        ]:
+            del self._slices[epoch]
+
+    def count(self) -> int:
+        return sum(sl[2] for sl in self._slices.values())
+
+    def total(self) -> float:
+        return sum(sl[3] for sl in self._slices.values())
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile (target rank ``ceil(q*n)``, the same
+        rule the repo's bench percentile uses) within relative error
+        ``alpha``; 0.0 for an empty sketch."""
+        n = self.count()
+        if n == 0:
+            return 0.0
+        target = min(n, max(1, math.ceil(q * n)))
+        zeros = sum(sl[1] for sl in self._slices.values())
+        if target <= zeros:
+            return 0.0
+        seen = zeros
+        merged: dict[int, int] = {}
+        for sl in self._slices.values():
+            for b, c in sl[0].items():
+                merged[b] = merged.get(b, 0) + c
+        for b in sorted(merged):
+            seen += merged[b]
+            if seen >= target:
+                return self.value_of(b)
+        return self.value_of(max(merged)) if merged else 0.0
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into self (cell-wise count sums). Requires
+        identical geometry — merging sketches with different ``alpha``
+        or ``slice_s`` would mix incompatible bucket meanings."""
+        if not math.isclose(other.alpha, self.alpha, rel_tol=1e-9):
+            raise ValueError(
+                f"cannot merge sketches with alpha {other.alpha} into {self.alpha}"
+            )
+        if not math.isclose(other.slice_s, self.slice_s, rel_tol=1e-9):
+            raise ValueError(
+                f"cannot merge sketches with slice_s {other.slice_s} into {self.slice_s}"
+            )
+        for epoch, osl in other._slices.items():
+            sl = self._slices.get(epoch)
+            if sl is None:
+                sl = self._slices[epoch] = [{}, 0, 0, 0.0]
+            for b, c in osl[0].items():
+                sl[0][b] = sl[0].get(b, 0) + c
+            sl[1] += osl[1]
+            sl[2] += osl[2]
+            sl[3] += osl[3]
+        return self
+
+    def to_wire(self) -> dict:
+        """JSON-safe wire form (the /debug/slo?raw=1 payload unit)."""
+        return {
+            "alpha": self.alpha,
+            "window_s": self.window_s,
+            "slice_s": self.slice_s,
+            "slices": {
+                str(epoch): {
+                    "b": {str(b): c for b, c in sl[0].items()},
+                    "z": sl[1],
+                    "n": sl[2],
+                    "s": sl[3],
+                }
+                for epoch, sl in self._slices.items()
+            },
+        }
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "QuantileSketch":
+        window_s = float(data["window_s"])
+        slice_s = float(data.get("slice_s") or window_s / cls.DEFAULT_SLICES)
+        sk = cls(
+            alpha=float(data["alpha"]),
+            window_s=window_s,
+            slices=max(1, round(window_s / slice_s)),
+        )
+        for epoch, sl in (data.get("slices") or {}).items():
+            sk._slices[int(epoch)] = [
+                {int(b): int(c) for b, c in (sl.get("b") or {}).items()},
+                int(sl.get("z", 0)),
+                int(sl.get("n", 0)),
+                float(sl.get("s", 0.0)),
+            ]
+        return sk
+
 
 class SLOAccountant:
     """Per-queue sliding-window latency percentiles. Two kinds:
@@ -487,64 +661,102 @@ class SLOAccountant:
     histograms in metrics/, these windows answer "is queue Q meeting
     its SLO *right now*" — the admission-lane input (ROADMAP item 1).
 
-    Always on (a deque append is cheap and the SLO surface must not go
-    dark when tracing is off); the window length comes from
+    Backed by mergeable :class:`QuantileSketch` rings (one per
+    kind × queue) rather than raw sample windows, so N federated
+    shards' accountants compose into one cluster-wide percentile
+    (obs/fleet); quantiles carry the sketch's declared relative error
+    ``alpha`` (default 1%). Queue cardinality is LRU-bounded at
+    ``max_queues`` (default 256): a tenant-name churn storm evicts the
+    coldest queue, metered on ``slo_evicted_queues_total``, and drops
+    its label sets from the slo gauges.
+
+    Always on (a sketch increment is cheap and the SLO surface must
+    not go dark when tracing is off); the window length comes from
     ``KBT_SLO_WINDOW_S`` (seconds, default 300)."""
 
     KINDS = ("time_to_bind", "queue_wait")
+    MAX_QUEUES = 256
 
-    def __init__(self, window_s: float | None = None) -> None:
+    def __init__(
+        self,
+        window_s: float | None = None,
+        max_queues: int | None = None,
+        alpha: float = QuantileSketch.DEFAULT_ALPHA,
+    ) -> None:
         if window_s is None:
             try:
                 window_s = float(os.environ.get(SLO_WINDOW_ENV, "") or 300.0)
             except ValueError:
                 window_s = 300.0
         self.window_s = window_s
+        self.alpha = float(alpha)
+        self.max_queues = int(
+            max_queues if max_queues is not None else self.MAX_QUEUES
+        )
         self._lock = threading.Lock()
-        # kind -> queue -> deque[(monotonic_ts, seconds)]
-        self._windows: dict[str, dict[str, collections.deque]] = {
-            k: {} for k in self.KINDS
+        # kind -> queue -> sketch, LRU-ordered (oldest-touched first)
+        self._sketches: dict[str, "collections.OrderedDict[str, QuantileSketch]"] = {
+            k: collections.OrderedDict() for k in self.KINDS
         }
 
-    def _trim(self, dq: collections.deque, now: float) -> None:
-        horizon = now - self.window_s
-        while dq and dq[0][0] < horizon:
-            dq.popleft()
-
     def observe(self, kind: str, queue: str, seconds: float) -> None:
-        if kind not in self._windows:
+        if kind not in self._sketches:
             return
         queue = queue or "default"
-        now = time.monotonic()
         with self._lock:
-            dq = self._windows[kind].setdefault(queue, collections.deque())
-            dq.append((now, seconds))
-            self._trim(dq, now)
+            per_queue = self._sketches[kind]
+            sk = per_queue.get(queue)
+            if sk is None:
+                sk = per_queue[queue] = QuantileSketch(
+                    alpha=self.alpha, window_s=self.window_s
+                )
+                while len(per_queue) > self.max_queues:
+                    evicted, _ = per_queue.popitem(last=False)
+                    metrics.register_slo_evicted_queue()
+                    metrics.drop_slo_queue(evicted)
+            else:
+                per_queue.move_to_end(queue)
+            sk.add(seconds)
 
     def reset(self) -> None:
         with self._lock:
-            for per_queue in self._windows.values():
+            for per_queue in self._sketches.values():
                 per_queue.clear()
 
     def snapshot(self) -> dict:
         """``{kind: {queue: {p50, p90, p99, n, window_s}}}`` over the
-        currently in-window observations."""
-        now = time.monotonic()
+        currently in-window observations (n is exact; quantiles within
+        relative error ``alpha``)."""
+        now = time.time()
         out: dict[str, dict] = {}
         with self._lock:
-            for kind, per_queue in self._windows.items():
+            for kind, per_queue in self._sketches.items():
                 out[kind] = {}
-                for queue, dq in per_queue.items():
-                    self._trim(dq, now)
-                    values = sorted(v for _, v in dq)
-                    if not values:
+                for queue, sk in per_queue.items():
+                    sk.trim(now)
+                    n = sk.count()
+                    if n == 0:
                         continue
-                    n = len(values)
                     stats = {"n": n, "window_s": self.window_s}
                     for label, q in _QUANTILES:
-                        idx = min(n - 1, max(0, int(q * n + 0.999999) - 1))
-                        stats[label] = values[idx]
+                        stats[label] = sk.quantile(q)
                     out[kind][queue] = stats
+        return out
+
+    def raw(self) -> dict:
+        """The mergeable wire form (``/debug/slo?raw=1``): serialized
+        per-kind × per-queue sketches a fleet aggregator deserializes
+        with :meth:`QuantileSketch.from_wire` and merges."""
+        now = time.time()
+        out: dict = {"alpha": self.alpha, "window_s": self.window_s, "kinds": {}}
+        with self._lock:
+            for kind, per_queue in self._sketches.items():
+                out["kinds"][kind] = {}
+                for queue, sk in per_queue.items():
+                    sk.trim(now)
+                    if sk.count() == 0:
+                        continue
+                    out["kinds"][kind][queue] = sk.to_wire()
         return out
 
     def publish(self) -> dict:

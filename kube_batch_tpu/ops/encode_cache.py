@@ -448,6 +448,9 @@ class TensorArena:
         self.row_updates = 0
         self.full_uploads = 0
         self.rows_uploaded = 0
+        # high-water mark of live device bytes across all slabs+banks,
+        # refreshed by device_view; the bench's HBM column
+        self.hbm_watermark_bytes = 0
 
     @property
     def bank(self) -> int:
@@ -497,7 +500,29 @@ class TensorArena:
             if host is None:
                 continue
             out[name] = self.upload(name, host, mesh=mesh)
+        self._account_hbm()
         return out
+
+    def hbm_bytes_by_slab(self) -> dict[str, int]:
+        """Live device bytes per managed slab, summed over banks (in
+        pipelined mode both double-buffers are resident, so both
+        count)."""
+        out: dict[str, int] = {}
+        for (name, _bank), slot in self._slots.items():
+            nbytes = getattr(slot.device, "nbytes", None)
+            if nbytes is None:
+                continue
+            out[name] = out.get(name, 0) + int(nbytes)
+        return out
+
+    def _account_hbm(self) -> None:
+        total = 0
+        for slab, nbytes in self.hbm_bytes_by_slab().items():
+            metrics.set_arena_hbm_bytes(slab, nbytes)
+            total += nbytes
+        if total > self.hbm_watermark_bytes:
+            self.hbm_watermark_bytes = total
+        metrics.set_arena_hbm_watermark(self.hbm_watermark_bytes)
 
     def refresh(self, views: list, name: str, host, mesh=None) -> None:
         """Re-upload one array (the action's pod_sc refresh between
@@ -548,6 +573,7 @@ class TensorArena:
     def clear(self) -> None:
         self._slots.clear()
         self._bank = 0
+        self.hbm_watermark_bytes = 0
 
 
 def _row_scatter(device_buf, rows: np.ndarray, new_host: np.ndarray):

@@ -17,11 +17,15 @@ phase with the *next* cycle:
   fire. Degradation is sticky until :func:`reset` (operator action /
   test hygiene) because a fence that timed out once has already proven
   the overlap assumption wrong for this process.
-- overlap accounting — ``pipeline_overlap_fraction`` is
-  ``(dispatch_duration - fence_wait) / dispatch_duration``: 1.0 means
-  the dispatch finished entirely under the next cycle's work, 0.0 means
-  the fence serialized the cycles after all. ``pipeline_fence_wait_seconds``
-  records every wait.
+- overlap accounting — ``pipeline_overlap_fraction`` is MEASURED, not
+  inferred: the deferred finisher stamps its dispatch window
+  ``[d0, d1]`` (:meth:`DispatchFence.record_dispatch_window`), the
+  consumer's join stamps its blocked window ``[w0, w1]``, and the
+  fraction is ``1 - |[w0,w1] ∩ [d0,d1]| / (d1 - d0)``: 1.0 means the
+  dispatch ran entirely under the next cycle's work, 0.0 means the
+  fence serialized the cycles after all. Exposed as
+  ``fence.last_overlap_fraction`` for the bench's per-row column;
+  ``pipeline_fence_wait_seconds`` records every wait.
 
 Sessions carry the in-flight work as ``ssn.deferred_dispatch`` (a
 ``concurrent.futures.Future``); ``framework.close_session`` joins it
@@ -100,6 +104,12 @@ class DispatchFence:
         self._lock = threading.Lock()
         self._future: Optional[Future] = None
         self._dispatch_s = 0.0
+        self._dispatch_t0 = 0.0
+        self._dispatch_t1 = 0.0
+        # one overlap sample per dispatch window: the FIRST join after a
+        # window records it, later joins of the same window do not
+        self._overlap_fresh = False
+        self.last_overlap_fraction: Optional[float] = None
         self.degraded_reason: Optional[str] = None
 
     def arm(self, future: Future) -> None:
@@ -110,11 +120,36 @@ class DispatchFence:
         with self._lock:
             return self._future is not None and not self._future.done()
 
-    def record_dispatch_seconds(self, seconds: float) -> None:
-        """Called by the deferred finisher with its own wall duration —
-        the denominator of the overlap fraction."""
+    def record_dispatch_window(self, t0: float, t1: float) -> None:
+        """Called by the deferred finisher with its own
+        ``time.perf_counter()`` start/end stamps — the denominator of
+        the measured overlap fraction."""
         with self._lock:
-            self._dispatch_s = float(seconds)
+            self._dispatch_t0 = float(t0)
+            self._dispatch_t1 = float(t1)
+            self._dispatch_s = max(0.0, float(t1) - float(t0))
+            self._overlap_fresh = True
+
+    def record_dispatch_seconds(self, seconds: float) -> None:
+        """Back-compat duration form: a dispatch that just finished,
+        ``seconds`` long (window ends now)."""
+        now = time.perf_counter()
+        self.record_dispatch_window(now - float(seconds), now)
+
+    def record_join(self, w0: float, w1: float) -> None:
+        """One consumer join of the deferred dispatch, blocked over
+        ``[w0, w1]``. Computes the device-event-honest overlap fraction
+        against the recorded dispatch window: the share of the dispatch
+        that did NOT block the join."""
+        with self._lock:
+            if not self._overlap_fresh or self._dispatch_t1 <= self._dispatch_t0:
+                return
+            d0, d1 = self._dispatch_t0, self._dispatch_t1
+            self._overlap_fresh = False
+        blocked = max(0.0, min(w1, d1) - max(w0, d0))
+        fraction = max(0.0, min(1.0, 1.0 - blocked / (d1 - d0)))
+        self.last_overlap_fraction = fraction
+        metrics.set_pipeline_overlap_fraction(fraction)
 
     def degrade(self, reason: str) -> None:
         """Sticky: flips :func:`enabled` false for the process, loudly."""
@@ -160,16 +195,13 @@ class DispatchFence:
             self.degrade(f"deferred dispatch raised {type(e).__name__}: {e}")
             with self._lock:
                 self._future = None
-        waited = time.perf_counter() - t0
-        metrics.observe_pipeline_fence_wait(waited)
+        t1 = time.perf_counter()
+        metrics.observe_pipeline_fence_wait(t1 - t0)
         with self._lock:
             if ok:
                 self._future = None
-            d = self._dispatch_s
-        if ok and d > 0.0:
-            metrics.set_pipeline_overlap_fraction(
-                max(0.0, min(1.0, (d - waited) / d))
-            )
+        if ok:
+            self.record_join(t0, t1)
         return ok
 
     def reset(self) -> None:
@@ -177,6 +209,10 @@ class DispatchFence:
             fut = self._future
             self._future = None
             self._dispatch_s = 0.0
+            self._dispatch_t0 = 0.0
+            self._dispatch_t1 = 0.0
+            self._overlap_fresh = False
+        self.last_overlap_fraction = None
         self.degraded_reason = None
         if fut is not None and not fut.done():
             try:
@@ -212,12 +248,16 @@ def submit(cache, fn: Callable[[], None]) -> Future:
 def join_session(ssn, timeout: Optional[float] = None) -> None:
     """Block until ``ssn``'s deferred dispatch (if any) has landed,
     re-raising its exception. close_session calls this before the commit
-    write-back; benches call it before reading binder state."""
+    write-back; benches call it before reading binder state. The join
+    window feeds the measured overlap fraction (the first join after a
+    dispatch window records it)."""
     fut = getattr(ssn, "deferred_dispatch", None)
     if fut is None:
         return
     ssn.deferred_dispatch = None
+    w0 = time.perf_counter()
     fut.result(timeout=timeout if timeout is not None else fence_timeout_s())
+    fence.record_join(w0, time.perf_counter())
 
 
 def reset() -> None:

@@ -31,6 +31,7 @@ import kube_batch_tpu.plugins  # noqa: F401  (registers the plugin builders)
 from kube_batch_tpu import faults, log, metrics, obs, pipeline
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.obs import explain as _obs_explain
+from kube_batch_tpu.obs import fleet as _obs_fleet
 from kube_batch_tpu.conf import (
     load_scheduler_conf,
     parse_scheduler_conf,
@@ -111,6 +112,7 @@ class Scheduler:
         self._conf_streaming = False
         self._conf_trace = ""
         self._conf_explain = ""
+        self._conf_fleet = ""
         self._stream_state = None
         self._stream_trigger = None
         self.micro_cycles_run = 0
@@ -132,10 +134,11 @@ class Scheduler:
                 )
                 conf_str = self._conf_cache or DEFAULT_SCHEDULER_CONF
         if conf_str == self._conf_cache:
-            # env flips (KBT_TRACE/KBT_EXPLAIN) still apply between conf
-            # pushes; the conf value, when set, wins
+            # env flips (KBT_TRACE/KBT_EXPLAIN/KBT_FLEET) still apply
+            # between conf pushes; the conf value, when set, wins
             obs.configure(self._conf_trace)
             _obs_explain.configure(self._conf_explain)
+            _obs_fleet.configure(self._conf_fleet)
             return
         try:
             self.actions, self.plugins, self.action_arguments = load_scheduler_conf(
@@ -148,6 +151,8 @@ class Scheduler:
             obs.configure(parsed.trace)
             self._conf_explain = parsed.explain
             _obs_explain.configure(parsed.explain)
+            self._conf_fleet = parsed.fleet
+            _obs_fleet.configure(parsed.fleet)
             # Conf-driven fault drills (the `faults:` key, same grammar as
             # KBT_FAULTS): armed only when the conf actually changed, so a
             # drill's fire counts are not re-armed every cycle.

@@ -546,8 +546,13 @@ def _make_handler(server: "SchedulerServer"):
             if path == "/metrics":
                 # Refresh the SLO quantile gauges from the sliding
                 # windows right before exposition — scrape-time freshness
-                # without a publisher thread.
+                # without a publisher thread. When this process is a
+                # fleet aggregator (KBT_FLEET), refresh the cluster-wide
+                # rollup the same way (internally rate-limited).
                 obs.slo.publish()
+                from kube_batch_tpu.obs import fleet as obs_fleet
+
+                obs_fleet.refresh()
                 self._reply(
                     200, metrics.render_prometheus_text(), "text/plain; version=0.0.4"
                 )
@@ -569,7 +574,23 @@ def _make_handler(server: "SchedulerServer"):
                     payload["dump"] = obs.recorder.dump(reason="debug_endpoint")
                 self._reply(200, json.dumps(payload))
             elif path == "/debug/slo":
-                self._reply(200, json.dumps(obs.slo.snapshot()))
+                # ``?raw=1`` returns the serialized mergeable sketches
+                # (the fleet aggregation wire form) instead of the
+                # human-readable quantile snapshot.
+                query = urllib.parse.parse_qs(parsed.query)
+                if query.get("raw", ["0"])[0] not in ("", "0", "false"):
+                    from kube_batch_tpu.obs import fleet as obs_fleet
+
+                    self._reply(200, json.dumps(obs_fleet.raw_slo_payload()))
+                else:
+                    self._reply(200, json.dumps(obs.slo.snapshot()))
+            elif path == "/debug/fleet":
+                # The cluster-wide rollup: a forced scrape of the
+                # configured peers, merged. {"enabled": false} when
+                # KBT_FLEET is off.
+                from kube_batch_tpu.obs import fleet as obs_fleet
+
+                self._reply(200, json.dumps(obs_fleet.refresh(force=True)))
             elif path == "/debug/explain":
                 # Unschedulability forensics registry (obs/explain):
                 # per-gang reason records + cross-gang aggregate;
@@ -1317,6 +1338,16 @@ def build_parser() -> argparse.ArgumentParser:
         "failover; reconciled against store truth on startup/takeover "
         "(env KBT_JOURNAL; empty = journaling off)",
     )
+    p.add_argument(
+        "--fleet",
+        default="",
+        help="comma-separated peer base URLs (http://host:port) to "
+        "aggregate fleet-wide SLO sketches and counters from — serves "
+        "cluster-wide kbt..._fleet_* gauges on /metrics and the merged "
+        "rollup on /debug/fleet (env KBT_FLEET; empty = off). Works "
+        "from any scheduler, or standalone with an unmatched "
+        "--scheduler-name as a dedicated observatory",
+    )
     p.add_argument("--version", action="store_true", help="show version and quit")
     p.add_argument("-v", type=int, default=0, help="log verbosity (glog -v)")
     return p
@@ -1337,6 +1368,15 @@ def run(argv: Optional[list[str]] = None) -> None:
     # caught — that story is the dump-on-fault/abort paths plus the
     # journal trace links.
     obs.install_signal_dump()
+    if opt.fleet:
+        # The flag arms the same env the hot-reload path resolves, so a
+        # conf without a fleet: key cannot undo it on the next cycle.
+        import os as _os
+
+        from kube_batch_tpu.obs import fleet as _fleet
+
+        _os.environ[_fleet.ENV] = opt.fleet
+        _fleet.configure()
 
     elector = None
     if opt.leader_elect:

@@ -266,7 +266,13 @@ class StreamTrigger:
                     queue = self._queues.get(gang_key_of(obj), "default")
                 metrics.set_streaming_backlog(backlog)
                 if t0 is not None:
-                    metrics.observe_time_to_bind(now - t0)
+                    # exemplar (KBT_METRICS_EXEMPLARS): the ambient trace
+                    # id links this latency sample to the micro-cycle
+                    # that bound the pod ("" when tracing is off — not
+                    # stored)
+                    metrics.observe_time_to_bind(
+                        now - t0, exemplar=obs.current_trace_id()
+                    )
                     obs.slo.observe("time_to_bind", queue, now - t0)
                     # Synthetic span: the arrival->bind interval was
                     # measured between two watch events, not inside a
