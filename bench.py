@@ -685,6 +685,53 @@ tiers:
     }
 
 
+def federation_kill_mttr_row(sessions: int = 5) -> dict:
+    """Federated kill-and-adopt MTTR (ISSUE 16): four leased shard
+    owners over one store, one killed mid-``bind_many`` (its binder
+    raises on every subsequent dispatch and its slot manager stops
+    renewing without releasing — the SIGKILL shape). A survivor must
+    win the expired slot lease, reconcile the dead owner's write-intent
+    journal, and re-drive the orphaned backlog.
+
+    MTTR = kill -> first bind landing in the victim's slot; the row
+    reports p50/p90 over ``sessions`` runs plus the lease-takeover
+    latencies. Correctness (exactly-once, union parity vs a
+    single-scheduler twin, fsck-clean store, single adopter) is
+    asserted per session by ``smoke_kill_one`` itself. Acceptance:
+    p50 <= lease TTL + renew period.
+    """
+    from kube_batch_tpu.federation import smoke_kill_one
+
+    lease_s, renew_s = 1.0, 0.25
+    mttrs, takeovers = [], []
+    for _ in range(sessions):
+        out = smoke_kill_one(
+            shards=4, gangs=16, members=2, lease_s=lease_s, renew_s=renew_s
+        )
+        assert out["ok"], f"kill drill failed: {out}"
+        mttrs.append(out["mttr_s"])
+        takeovers.append(out["takeover_s"])
+    mttrs.sort()
+    takeovers.sort()
+    return {
+        "sessions": sessions,
+        "p50_s": round(percentile(mttrs, 50), 4),
+        "p90_s": round(percentile(mttrs, 90), 4),
+        "takeover_p50_s": round(percentile(takeovers, 50), 4),
+        "takeover_p90_s": round(percentile(takeovers, 90), 4),
+        "lease_duration_s": lease_s,
+        "renew_period_s": renew_s,
+        "shards": 4,
+        "p50_within_lease_window": percentile(mttrs, 50) <= lease_s + renew_s,
+        "note": (
+            "leased-slot federation kill drill: victim's binder dies "
+            "mid-bind_many, survivor adopts the expired slot lease, "
+            "reconciles the dead WAL and re-drives the backlog; MTTR = "
+            "kill -> first bind in the victim's slot"
+        ),
+    }
+
+
 def federation_scaleout_row(
     gangs: int = 5000,
     members: int = 10,
@@ -1392,6 +1439,13 @@ def main() -> None:
     # lease for the row), reconciles the journal, and its first
     # re-dispatched bind stops the clock. sessions>=5, p50/p90.
     details["failover_mttr"] = failover_mttr_row(sessions=5)
+
+    # Federated kill-and-adopt MTTR (ISSUE 16): one of four leased shard
+    # owners killed mid-bind_many; MTTR = kill -> first bind landing in
+    # the orphaned slot after a survivor adopts it (lease wait-out +
+    # journal reconciliation + backlog re-drive). p50 must sit within
+    # lease TTL + renew period. sessions>=5, p50/p90.
+    details["federation_kill_mttr"] = federation_kill_mttr_row(sessions=5)
 
     # Sharded federation scale-out (ISSUE 10): 1/2/4/8 active schedulers
     # over one store on a 50k-pod world — aggregate binds/s plus the

@@ -22,8 +22,10 @@ Three classes of finding, each printed as one line:
   ``xla_s`` on rows without percentiles) grew by more than
   ``--threshold`` (default 15%);
 - ``parity``: a parity bit (``placements_equal_serial``,
-  ``placements_equal_full_cycle``) that was true in OLD is false or
-  gone in NEW — the device solver stopped matching its oracle, which
+  ``placements_equal_full_cycle``, or the kill-drill acceptance bit
+  ``p50_within_lease_window`` on ``federation_kill_mttr``) that was
+  true in OLD is false or gone in NEW — the device solver stopped
+  matching its oracle (or failover MTTR left its lease window), which
   no latency number excuses;
 - ``compiles``: a compile-budget change — ``measured_compiles`` (or
   ``warm_encode_compiles``) grew, meaning a row started paying
@@ -55,7 +57,13 @@ import sys
 
 # latency key preference per row: tail-honest median first
 _LATENCY_KEYS = ("p50_s", "xla_s")
-_PARITY_KEYS = ("placements_equal_serial", "placements_equal_full_cycle")
+# true->anything-else is a finding; covers placement parity and the
+# kill-drill MTTR acceptance bit (p50 <= lease TTL + renew period)
+_PARITY_KEYS = (
+    "placements_equal_serial",
+    "placements_equal_full_cycle",
+    "p50_within_lease_window",
+)
 _COMPILE_KEYS = ("measured_compiles", "warm_encode_compiles")
 # never-flagged telemetry columns (see module docstring)
 _INFO_KEYS = (

@@ -77,9 +77,13 @@ orphan count, and ``--strict`` must gate on it).
 With ``--federation``, the federation gate runs: the wire-path smoke
 (``python -m kube_batch_tpu.federation --json`` — N schedulers over one
 loopback store process, exactly-once binds, fsck-clean union placement,
-parity with a single-scheduler twin) plus a seeded in-process
+parity with a single-scheduler twin), a seeded in-process
 two-scheduler conflict drill whose loser must win its refresh-retry and
-leave store truth fsck-clean.
+leave store truth fsck-clean, and the kill-and-adopt drill
+(``python -m kube_batch_tpu.federation --json --kill-one`` — one of
+four leased shard owners killed mid-``bind_many``; a survivor must
+adopt the orphaned slot within the lease window, reconcile the dead
+owner's journal, and finish every gang exactly once, fsck-clean).
 
 Exit 0 iff every gate is clean.
 Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
@@ -390,8 +394,12 @@ raise SystemExit(0 if ok else 1)
 
 def run_federation_gate(env: dict) -> dict:
     """--federation: the wire-path smoke (python -m
-    kube_batch_tpu.federation --json) + the seeded in-process
-    two-scheduler conflict drill above. Returns a summary for --json."""
+    kube_batch_tpu.federation --json), the seeded in-process
+    two-scheduler conflict drill above, and the kill-and-adopt drill
+    (python -m kube_batch_tpu.federation --json --kill-one): kill one
+    of four shard owners mid-bind_many and require a survivor to adopt
+    the orphaned slot within the lease window with zero lost or
+    duplicate binds. Returns a summary for --json."""
     import json
 
     env = dict(env)
@@ -425,6 +433,22 @@ def run_federation_gate(env: dict) -> dict:
         print(res.stdout, res.stderr, sep="\n")
         print(f"verify: federation two-scheduler conflict drill FAILED ({drill})")
         ok = False
+    # the kill-and-adopt drill (no --strict: the unowned-window fsck
+    # observation is timing-dependent and covered deterministically by
+    # tests/test_resharding.py)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.federation", "--json", "--kill-one"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    kill: dict = {}
+    try:
+        kill = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: federation kill drill produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    if res.returncode != 0 or not kill.get("ok", False):
+        print(f"verify: federation kill-and-adopt drill FAILED ({kill})")
+        ok = False
     return {
         "ok": ok,
         "shards": summary.get("shards"),
@@ -432,6 +456,9 @@ def run_federation_gate(env: dict) -> dict:
         "exactly_once": summary.get("exactly_once"),
         "union_parity": summary.get("union_parity"),
         "drill_bound": drill.get("bound"),
+        "kill_adopter": kill.get("adopter"),
+        "kill_takeover_s": kill.get("takeover_s"),
+        "kill_mttr_s": kill.get("mttr_s"),
     }
 
 

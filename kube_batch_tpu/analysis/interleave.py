@@ -44,13 +44,15 @@ counterexample's trace id is its replay seed:
 ``python -m kube_batch_tpu.analysis.interleave --replay broken_drain:011``
 re-runs exactly that schedule step by step, verbosely.
 
-The six default scenarios: ``micro_vs_full``, ``event_vs_invalidate``,
+The seven default scenarios: ``micro_vs_full``, ``event_vs_invalidate``,
 ``takeover_vs_dispatch``, ``watch410_vs_drain`` (ISSUE 9),
 ``two_scheduler_conflict`` (ISSUE 10 — two federated schedulers racing
-optimistic gang dispatches onto one node), and
+optimistic gang dispatches onto one node),
 ``dispatch_vs_next_solve`` (ISSUE 13 — cycle N's deferred dispatch
 racing cycle N+1's snapshot through the KBT_PIPELINE dispatch
-fence). The intentionally broken fixture
+fence), and ``adopt_vs_dispatch`` (ISSUE 16 — slot adoption racing a
+straggler conditional dispatch from the killed owner). The
+intentionally broken fixture
 ``broken_drain`` (a trigger whose ``drain()`` empties the backlog
 instead of copy-until-prune) is excluded from the default set; it
 exists so the seeded-counterexample loop stays demonstrably alive —
@@ -743,6 +745,244 @@ class TwoSchedulerConflict(Scenario):
         return out
 
 
+class AdoptVsDispatch(Scenario):
+    name = "adopt_vs_dispatch"
+    describe = (
+        "slot adoption (ISSUE 16) racing a straggler dispatch from the "
+        "killed owner: the dead shard's in-flight conditional gang "
+        "transaction lands late — against the survivor's takeover "
+        "reconciliation and its post-adoption full cycle. Whichever "
+        "lands first, the optimistic check arbitrates: the straggler "
+        "either wins (reconciliation confirms the landed binds) or "
+        "loses StaleWrite (reconciliation already re-dispatched). Every "
+        "schedule must end with both gangs bound exactly once on the "
+        "journaled placements, both journals orphan-free, the slot "
+        "owned by the survivor, and fsck clean"
+    )
+
+    L_CACHE_V = "cache_victim._mutex"
+    L_CACHE_S = "cache_survivor._mutex"
+    L_JOURNAL_V = "journal_victim._lock"
+    L_JOURNAL_S = "journal_survivor._lock"
+
+    def build(self) -> None:
+        from kube_batch_tpu.api.job_info import job_key
+        from kube_batch_tpu.cache import ClusterStore
+        from kube_batch_tpu.cache.store import PODS, EventHandler
+        from kube_batch_tpu.federation import (
+            FederatedCache,
+            ShardSlotManager,
+            shard_index,
+            shard_journal_path,
+            slot_lease_name,
+        )
+        from kube_batch_tpu.recovery import WriteIntentJournal
+        from kube_batch_tpu.utils.locking import LockOrderWitness
+
+        self.store = ClusterStore()
+        self._seed(self.store, nodes=1)  # one node: parity is trivial
+        self.bind_counts: dict = {}
+
+        def on_update(old, new):
+            if not old.node_name and new.node_name:
+                key = f"{new.namespace}/{new.name}"
+                self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+
+        self.store.add_event_handler(PODS, EventHandler(on_update=on_update))
+
+        # gang "ga" belongs to the victim's slot; pick the survivor's
+        # own gang so it provably hashes into the OTHER slot (the
+        # scenario stays valid if crc32's bucket assignment changes)
+        self.victim_slot = shard_index(job_key("default", "ga"), 2)
+        self.survivor_slot = 1 - self.victim_slot
+        survivor_gang = next(
+            g for g in ("gb", "gc", "gd", "ge", "gf")
+            if shard_index(job_key("default", g), 2) == self.survivor_slot
+        )
+        self._arrive(self.store, "ga", 3)
+        self._arrive(self.store, survivor_gang, 3)
+        self.survivor_gang = survivor_gang
+
+        # journals live where adoption's takeover reconciliation looks:
+        # shard-{slot}.wal under the shared journal dir
+        self.journal = WriteIntentJournal(
+            shard_journal_path(self.workdir, self.victim_slot)
+        )
+        self.standby_journal = WriteIntentJournal(
+            shard_journal_path(self.workdir, self.survivor_slot)
+        )
+        victim = FederatedCache(
+            self.store, shard=self.victim_slot, shards=2, shard_key="gang",
+            journal=self.journal, binder=_CondDyingBinder(self.store),
+        )
+        self.cache_survivor = FederatedCache(
+            self.store, shard=self.survivor_slot, shards=2, shard_key="gang",
+            journal=self.standby_journal,
+        )
+        self.cache_victim = victim
+
+        # the kill, pre-schedule and deterministic: the victim journals
+        # its gang's intents, then its conditional transaction dies
+        # mid-flight (BaseException through the optimistic-bind path)
+        victim.snapshot()
+        self.stale_version = victim._snapshot_version
+        try:
+            _bind_gang_pending(victim, "ga")
+        except _DyingBinder.LeaderKilled:
+            pass
+        else:
+            raise RuntimeError("model error: conditional DyingBinder never fired")
+        replay = WriteIntentJournal.replay(self.journal.path)
+        if len(replay.orphans) != 3:
+            raise RuntimeError(
+                "model error: kill left "
+                f"{len(replay.orphans)} in-flight intent(s), wanted 3"
+            )
+        self.bindings = [
+            (*intent.pod.partition("/")[::2], intent.node)
+            for intent in sorted(replay.orphans, key=lambda i: i.seq)
+        ]
+
+        # the survivor: owns its slot (lease + manager state); the
+        # victim's slot lease is NOT created — an expired/never-renewed
+        # lease and a missing one take the same adoption path
+        self.mgr = ShardSlotManager(
+            self.store, self.cache_survivor, identity="survivor",
+            lease_s=1000.0, renew_s=100.0, adopt=True,
+            journal_dir=self.workdir, grace_s=0.0, rebalance=0,
+        )
+        self.store.try_acquire_lease(
+            slot_lease_name(self.survivor_slot), "survivor", 1000.0
+        )
+        self.mgr._set_owned({self.survivor_slot})
+
+        self.witness = LockOrderWitness()
+        self.store._lock = self.witness.wrap(L_STORE, self.store._lock)
+        self.cache_victim._mutex = self.witness.wrap(
+            self.L_CACHE_V, self.cache_victim._mutex
+        )
+        self.cache_survivor._mutex = self.witness.wrap(
+            self.L_CACHE_S, self.cache_survivor._mutex
+        )
+        self.journal._lock = self.witness.wrap(self.L_JOURNAL_V, self.journal._lock)
+        self.standby_journal._lock = self.witness.wrap(
+            self.L_JOURNAL_S, self.standby_journal._lock
+        )
+
+        def straggler():
+            # the dead owner's write was already on the wire: the SAME
+            # conditional transaction, carrying the snapshot version it
+            # captured before dying — the optimistic check decides
+            from kube_batch_tpu.cache.cache import StoreBinder
+            from kube_batch_tpu.cache.store import StaleWrite
+
+            try:
+                StoreBinder(self.store).bind_many_versioned(
+                    self.bindings, self.stale_version
+                )
+            except StaleWrite:
+                pass  # reconciliation landed first; the dead owner lost
+
+        def adopt():
+            # the probe's winning half: take the orphaned slot's lease,
+            # then the full takeover (reconcile the dead owner's journal,
+            # widen the owned set, re-ingest the backlog)
+            self.store.try_acquire_lease(
+                slot_lease_name(self.victim_slot), "survivor", 1000.0
+            )
+            self.mgr._adopt(self.victim_slot, t0=self.clock.now())
+
+        def survivor_full():
+            from kube_batch_tpu.cache import SchedulerCache  # noqa: F401
+            from kube_batch_tpu.scheduler import Scheduler
+
+            conf = os.path.join(self.workdir, "survivor.yaml")
+            with open(conf, "w", encoding="utf-8") as fh:
+                fh.write(_CONF)
+            Scheduler(self.cache_survivor, scheduler_conf=conf).run_once()
+
+        # every step can reach the store, both caches (commit events fan
+        # out to both mirrors synchronously) and both journals — nothing
+        # prunes, all three interleavings run
+        f_all = frozenset({
+            L_STORE, self.L_CACHE_V, self.L_CACHE_S,
+            self.L_JOURNAL_V, self.L_JOURNAL_S,
+        })
+        self.threads = [
+            [Step("straggler_dispatch_lands", straggler, f_all)],
+            [
+                Step("adopt_slot_takeover", adopt, f_all),
+                Step("survivor_full_cycle", survivor_full, f_all),
+            ],
+        ]
+
+    def invariants(self) -> list:
+        out = super().invariants()
+        from kube_batch_tpu.federation import fsck
+        from kube_batch_tpu.recovery import WriteIntentJournal
+
+        orphans = WriteIntentJournal.replay(self.standby_journal.path).orphans
+        if orphans:
+            out.append(
+                "survivor's journal left with unconfirmed intents: "
+                + ", ".join(f"{i.op} {i.pod} seq={i.seq}" for i in orphans)
+            )
+        if self.victim_slot not in self.cache_survivor.owned_slots:
+            out.append(
+                f"survivor never adopted slot {self.victim_slot} "
+                f"(owned: {sorted(self.cache_survivor.owned_slots)})"
+            )
+        placed = self.placements()
+        moved = {
+            f"{ns}/{name}": (placed.get(f"{ns}/{name}"), node)
+            for ns, name, node in self.bindings
+            if placed.get(f"{ns}/{name}") != node
+        }
+        if moved:
+            out.append(
+                "killed owner's gang diverged from its journaled "
+                f"placement (got, want): {moved}"
+            )
+        out.extend(fsck(self.store, shard_key="gang"))
+        return out
+
+
+class _CondDyingBinder:
+    """Conditional-path SIGKILL stand-in: the first optimistic gang
+    transaction dies mid-flight (after the intents are journaled,
+    before anything lands) — the adopt_vs_dispatch premise."""
+
+    def __init__(self, store) -> None:
+        from kube_batch_tpu.cache.cache import StoreBinder
+
+        self._inner = StoreBinder(store)
+
+    def bind_many_versioned(self, bindings, snapshot_version):
+        raise _DyingBinder.LeaderKilled()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def _bind_gang_pending(cache, gang: str) -> None:
+    """Dispatch every pending task of ``gang`` through the cache's bulk
+    (conditional) bind path — shared by the two federated scenarios."""
+    from kube_batch_tpu.api.job_info import job_key
+    from kube_batch_tpu.api.types import TaskStatus
+
+    uid = job_key("default", gang)
+    with cache._mutex:
+        job = cache.jobs.get(uid)
+        pending = (
+            list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
+            if job is not None
+            else []
+        )
+    if not pending:
+        raise RuntimeError(f"model error: gang {gang} has no pending tasks")
+    cache.bind_many([(t, "n0") for t in pending])
+
+
 # -- the intentionally broken fixture ----------------------------------------
 
 
@@ -910,6 +1150,7 @@ SCENARIOS = {
         Watch410VsDrain,
         TwoSchedulerConflict,
         DispatchVsNextSolve,
+        AdoptVsDispatch,
     )
 }
 FIXTURES = {BrokenDrain.name: BrokenDrain}

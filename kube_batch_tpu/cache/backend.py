@@ -38,6 +38,7 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Optional
 
@@ -371,6 +372,40 @@ class LoopbackBackend:
              "snapshotVersion": snapshot_version},
         )
         return payload.get("evicted")
+
+    def _lease_verb(self, name: str, verb: str, body: dict) -> Any:
+        """POST the arbiter's lease endpoint and reconstruct the Lease
+        the store returned, so callers (ShardSlotManager, electors) see
+        the same object shape from an HTTP arbiter as from an in-process
+        ClusterStore. The name is percent-encoded whole (safe="") — a
+        raw '/' would smear across path segments and arbitrate the
+        wrong scope."""
+        from kube_batch_tpu.apis.types import Lease, ObjectMeta
+
+        quoted = urllib.parse.quote(name, safe="")
+        payload = self._request(
+            f"lease.{verb}", "POST", f"/apis/v1alpha1/leases/{quoted}/{verb}", body
+        )
+        return Lease(
+            metadata=ObjectMeta(name=payload.get("name", name)),
+            holder_identity=str(payload.get("holder", "")),
+            lease_duration_seconds=float(payload.get("lease_duration", 0.0)),
+            renew_time=float(payload.get("renew_time", 0.0)),
+            lease_transitions=int(payload.get("transitions", 0)),
+        )
+
+    def try_acquire_lease(
+        self, name: str, identity: str, lease_duration: float = 15.0
+    ) -> Any:
+        """Acquire-or-renew through the arbiter (store.py semantics, the
+        arbiter's clock). Raises BackendPartitioned on transport failure
+        — the caller treats that as 'did not acquire this round'."""
+        return self._lease_verb(
+            name, "acquire", {"identity": identity, "lease_duration": lease_duration}
+        )
+
+    def release_lease(self, name: str, identity: str) -> Any:
+        return self._lease_verb(name, "release", {"identity": identity})
 
     def _crud(self, kind: str, verb: str, obj=None, key: Optional[str] = None) -> None:
         body: dict[str, Any] = {"verb": verb}

@@ -102,6 +102,10 @@ POINTS = (
     "store.conflict",      # conditional write rejected -> loser resyncs gang + retries
     "federation.partition",  # loopback backend transport drops -> backoff + relist heal
     "federation.stale_assign",  # dispatch carries a stale snapshot version on purpose
+    # leased shard slots (federation.py ShardSlotManager)
+    "shard.adopt",      # adoption takeover fails -> breaker-backed retry next probe
+    "shard.handoff",    # graceful handoff aborts mid-drain -> slot kept, loudly
+    "shard.lease_flap",  # own slot renewal dropped once -> reacquire, no double-adopt
     # native extension boundary (ops/, the bulk replay)
     "native.load",      # extension unavailable for the cycle -> Python twins
     "native.prepass",   # bulk_assign prepass raises -> Python replay

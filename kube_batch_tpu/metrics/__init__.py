@@ -377,6 +377,37 @@ store_backend_rtt = Histogram(
     FINE_BUCKETS,
 )
 
+# -- leased shard slots (kube_batch_tpu.federation ShardSlotManager) ---------
+# Dynamic shard ownership: each of the N shard slots is a store lease;
+# a scheduler holds its primary slot, adopts orphaned ones, and hands
+# slots off for planned moves/rebalancing.
+shard_slots_owned = Gauge(
+    f"{_SUBSYSTEM}_shard_slots_owned",
+    "Shard slots this scheduler currently holds the lease for "
+    "(1 = just the primary; more = adopted orphans)",
+)
+shard_slot_owned = Gauge(
+    f"{_SUBSYSTEM}_shard_slot_owned",
+    "Per-slot ownership flag for this scheduler (labels: slot; 1 = this "
+    "process holds the slot's lease, 0 = it does not)",
+)
+shard_adoptions = Counter(
+    f"{_SUBSYSTEM}_shard_adoptions_total",
+    "Orphaned shard-slot adoption attempts, by outcome "
+    "(adopted/failed/lost_race/flap_suppressed)",
+)
+shard_handoffs = Counter(
+    f"{_SUBSYSTEM}_shard_handoffs_total",
+    "Graceful shard-slot handoffs (planned moves / conflict rebalance), "
+    "by outcome (completed/aborted)",
+)
+shard_takeover_seconds = Histogram(
+    f"{_SUBSYSTEM}_shard_takeover_seconds",
+    "Measured takeover time per adopted slot: lease acquire through "
+    "journal reconciliation and backlog re-ingest, in seconds",
+    E2E_BUCKETS,
+)
+
 # -- unschedulability forensics (kube_batch_tpu.obs.explain) -----------------
 unschedulable_total = Counter(
     f"{_SUBSYSTEM}_unschedulable_total",
@@ -465,6 +496,18 @@ fleet_shards_scraped = Gauge(
     f"{_SUBSYSTEM}_fleet_shards_scraped",
     "Peer shards the fleet aggregator reached on its last scrape "
     "(a drop below the configured peer count means a dark shard)",
+)
+fleet_shard_up = Gauge(
+    f"{_SUBSYSTEM}_fleet_shard_up",
+    "Per-peer reachability on the last fleet scrape (labels: shard = "
+    "peer URL; 1 = scraped, 0 = dark) — attributes a dark shard before "
+    "its slot lease even expires",
+)
+fleet_shard_scrape_age = Gauge(
+    f"{_SUBSYSTEM}_fleet_shard_last_scrape_age_seconds",
+    "Seconds since the fleet aggregator last successfully scraped each "
+    "peer (labels: shard = peer URL; grows without bound on a dark "
+    "shard, -1 = never scraped)",
 )
 
 # -- device-phase telemetry (arena HBM accounting, ops/encode_cache) ---------
@@ -614,6 +657,26 @@ def observe_store_backend_rtt(op: str, seconds: float) -> None:
     store_backend_rtt.observe(seconds, {"op": op})
 
 
+def set_shard_slots_owned(n: int) -> None:
+    shard_slots_owned.set(n)
+
+
+def set_shard_slot_owned(slot: int, owned: bool) -> None:
+    shard_slot_owned.set(1 if owned else 0, {"slot": str(slot)})
+
+
+def register_shard_adoption(outcome: str) -> None:
+    shard_adoptions.inc({"outcome": outcome})
+
+
+def register_shard_handoff(outcome: str) -> None:
+    shard_handoffs.inc({"outcome": outcome})
+
+
+def observe_shard_takeover(seconds: float) -> None:
+    shard_takeover_seconds.observe(seconds)
+
+
 def register_unschedulable(reason: str) -> None:
     unschedulable_total.inc({"reason": reason})
 
@@ -663,6 +726,14 @@ def set_fleet_pods_per_second(value: float) -> None:
 
 def set_fleet_shards_scraped(n: int) -> None:
     fleet_shards_scraped.set(n)
+
+
+def set_fleet_shard_up(shard: str, up: bool) -> None:
+    fleet_shard_up.set(1 if up else 0, {"shard": shard})
+
+
+def set_fleet_shard_scrape_age(shard: str, age_s: float) -> None:
+    fleet_shard_scrape_age.set(age_s, {"shard": shard})
 
 
 def set_arena_hbm_bytes(slab: str, nbytes: float) -> None:
@@ -796,6 +867,11 @@ def render_prometheus_text() -> str:
         federation_node_conflicts,
         bind_retries,
         store_backend_rtt,
+        shard_slots_owned,
+        shard_slot_owned,
+        shard_adoptions,
+        shard_handoffs,
+        shard_takeover_seconds,
         unschedulable_total,
         would_fit_if_total,
         pipeline_overlap_fraction,
@@ -810,6 +886,8 @@ def render_prometheus_text() -> str:
         fleet_backlog,
         fleet_pods_per_second,
         fleet_shards_scraped,
+        fleet_shard_up,
+        fleet_shard_scrape_age,
         arena_hbm_bytes,
         arena_hbm_watermark,
     ]

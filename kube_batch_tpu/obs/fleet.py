@@ -18,7 +18,11 @@ publishes cluster-wide gauges:
   between scrapes (the conflict-aware-scoring input, ROADMAP item 2);
 - ``kube_batch_tpu_fleet_backlog_pods`` / ``..._pods_per_second`` /
   ``..._shards_scraped`` — aggregate backlog, bind throughput from
-  bind-count deltas, and scrape reachability.
+  bind-count deltas, and scrape reachability;
+- ``kube_batch_tpu_fleet_shard_up{shard}`` /
+  ``..._fleet_shard_last_scrape_age_seconds{shard}`` — per-peer
+  reachability and staleness (the dead-shard signal the resharding
+  runbook's triage ladder starts from).
 
 Off by default, same single-branch discipline as ``KBT_TRACE``: when
 ``KBT_FLEET`` is empty/off, :func:`refresh` is one bool check returning
@@ -162,6 +166,7 @@ class FleetAggregator:
         self._prev_nodes: dict[str, float] = {}
         self._prev_binds: float | None = None
         self._prev_binds_mono = 0.0
+        self._last_seen: dict[str, float] = {}  # peer url -> last good scrape
         self.last: dict = {}
 
     def scrape(self, base_url: str, timeout: float = 3.0) -> dict | None:
@@ -179,7 +184,10 @@ class FleetAggregator:
             self._prev_nodes = {}
             self._prev_binds = None
             self._prev_binds_mono = 0.0
+            self._last_seen = {}
             self.last = {}
+        metrics.fleet_shard_up.clear()
+        metrics.fleet_shard_scrape_age.clear()
 
     def refresh(self, force: bool = False) -> dict:
         if not _enabled:
@@ -238,6 +246,21 @@ class FleetAggregator:
             binds += float(counters.get("binds_total") or 0.0)
         now = time.monotonic()
         with self._lock:
+            # per-shard reachability: up 0/1 plus seconds since the last
+            # good scrape (-1 = never reached) — the fleet-level "is that
+            # shard dead" signal the resharding runbook's triage starts
+            # from (a shard can be down while its slot lease is still
+            # ticking out)
+            reached_set = set(reached)
+            shard_up: dict[str, bool] = {}
+            scrape_age: dict[str, float] = {}
+            for peer in peer_list:
+                up = peer in reached_set
+                shard_up[peer] = up
+                if up:
+                    self._last_seen[peer] = now
+                seen = self._last_seen.get(peer)
+                scrape_age[peer] = (now - seen) if seen is not None else -1.0
             deltas = {
                 node: value - self._prev_nodes.get(node, 0.0)
                 for node, value in node_totals.items()
@@ -258,6 +281,8 @@ class FleetAggregator:
                 "enabled": True,
                 "peers": list(peer_list),
                 "shards_scraped": len(reached),
+                "shard_up": shard_up,
+                "shard_scrape_age_s": scrape_age,
                 "slo": slo_out,
                 "node_conflict_topk": top,
                 "backlog_pods": backlog,
@@ -268,6 +293,9 @@ class FleetAggregator:
         metrics.set_fleet_backlog(backlog)
         metrics.set_fleet_pods_per_second(pods_per_s)
         metrics.set_fleet_shards_scraped(len(reached))
+        for peer in peer_list:
+            metrics.set_fleet_shard_up(peer, shard_up[peer])
+            metrics.set_fleet_shard_scrape_age(peer, scrape_age[peer])
         return payload
 
 
@@ -333,7 +361,10 @@ def smoke(shards: int = 2, gangs: int = 8, members: int = 3,
     4. assert merged cluster-wide p50/p90/p99 agree with pooled-raw
        nearest-rank ground truth within the sketch's declared relative
        error, exact sample counts match, every pod bound exactly once,
-       fsck is clean, and the throughput gauge moved.
+       fsck is clean, and the throughput gauge moved;
+    5. kill one observatory and re-scrape: ``fleet_shard_up`` must flip
+       to 0 for exactly the killed peer (survivors stay up) and its
+       last-scrape age must start growing.
     """
     import threading as _threading
 
@@ -453,6 +484,15 @@ def smoke(shards: int = 2, gangs: int = 8, members: int = 3,
             scheds.append((sched, thread))
         all_bound = _wait_all_bound(server.store, total, deadline_s=60.0)
         payload = aggregator.refresh(force=True)
+        # kill one shard's observatory and re-scrape: the per-shard
+        # reachability gauges must flip (up -> 0, scrape age starts
+        # growing) while the survivors stay up
+        killed_url = urls[-1]
+        srv_k, thread_k = observatories.pop()
+        srv_k.shutdown()
+        srv_k.server_close()
+        thread_k.join(timeout=5.0)
+        down_payload = aggregator.refresh(force=True)
     finally:
         stop.set()
         for _, thread in scheds:
@@ -501,6 +541,24 @@ def smoke(shards: int = 2, gangs: int = 8, members: int = 3,
     violations = fsck(server.store)
     within_bound = bool(compare) and max_rel_err <= alpha * 1.05 + 1e-9
 
+    # killed-shard detection: every shard up before the kill; after it,
+    # exactly the killed one reports down — in the payload AND in the
+    # published fleet_shard_up gauge — with its scrape age now growing
+    up_before = payload.get("shard_up", {})
+    up_after = down_payload.get("shard_up", {})
+    age_after = down_payload.get("shard_scrape_age_s", {})
+    gauge_up = {
+        dict(key).get("shard", ""): value
+        for key, value in metrics.fleet_shard_up.samples().items()
+    }
+    killed_shard_detected = bool(
+        all(up_before.get(u) for u in urls)
+        and up_after.get(killed_url) is False
+        and all(up_after.get(u) for u in urls if u != killed_url)
+        and gauge_up.get(killed_url) == 0.0
+        and age_after.get(killed_url, -1.0) >= 0.0
+    )
+
     out = {
         "shards": shards,
         "pods": total,
@@ -518,12 +576,16 @@ def smoke(shards: int = 2, gangs: int = 8, members: int = 3,
         "pods_per_second": payload.get("pods_per_second", 0.0),
         "backlog_pods": payload.get("backlog_pods", 0.0),
         "node_conflict_topk": payload.get("node_conflict_topk", {}),
+        "scraped_after_kill": down_payload.get("shards_scraped", 0),
+        "killed_shard_detected": killed_shard_detected,
     }
     out["ok"] = bool(
         all_bound
         and exactly_once
         and not violations
         and out["shards_scraped"] == shards
+        and out["scraped_after_kill"] == shards - 1
+        and killed_shard_detected
         and counts_match
         and within_bound
         and out["pods_per_second"] > 0.0

@@ -241,6 +241,22 @@ class Scheduler:
             self._stream_state = None
             log.infof("streaming mode off: back to the fixed-period loop")
 
+    def on_owned_slots_changed(self, adopted_keys, removed_keys=()) -> None:
+        """Shard-slot ownership changed mid-run (federation.py
+        ShardSlotManager adoption/handoff). In streaming mode, seed the
+        adopted gang keys into the trigger and prune the handed-off
+        ones — the resident node table stays valid (node state did not
+        change), so only the adopted keys' gangs need solving and the
+        next micro-cycle serves exactly them. In periodic mode the next
+        full cycle re-snapshots the widened mirror; nothing to do."""
+        trigger = self._stream_trigger
+        if trigger is None:
+            return
+        if removed_keys:
+            trigger.prune(set(removed_keys))
+        if adopted_keys:
+            trigger.seed(set(adopted_keys))
+
     def run_micro(self, work) -> bool:
         """One micro-cycle over the drained churn. Returns True when the
         backlog was served (or there was nothing to solve); False means

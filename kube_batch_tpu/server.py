@@ -1174,23 +1174,52 @@ class SchedulerServer:
             from kube_batch_tpu.recovery import WriteIntentJournal
 
             self.journal = WriteIntentJournal(journal_path)
+        self.slot_manager = None
         if self.backend is not None:
             from kube_batch_tpu.federation import (
                 ENV as FED_ENV,
                 FederatedCache,
                 parse_shard_spec,
+                shard_journal_dir,
+                shard_journal_path,
                 shard_key_mode,
             )
 
             shard, shards = parse_shard_spec(
                 os.environ.get(FED_ENV, "").strip() or "1"
             )
+            # Dynamic resharding: KBT_SHARD_JOURNAL_DIR gives every shard
+            # a well-known per-slot journal (shard-{i}.wal) that a
+            # survivor reconciles on adoption; an explicit --journal /
+            # KBT_JOURNAL path wins.
+            if self.journal is None and shards > 1 and shard_journal_dir():
+                from kube_batch_tpu.recovery import WriteIntentJournal
+
+                self.journal = WriteIntentJournal(
+                    shard_journal_path(shard_journal_dir(), shard)
+                )
             self.cache = FederatedCache(
                 self.backend, shard=shard, shards=shards,
                 shard_key=shard_key_mode(), scheduler_name=scheduler_name,
                 default_queue=default_queue, journal=self.journal,
                 staleness_fn=self.backend.snapshot_age,
             )
+            if shards > 1:
+                # Leased shard slots: this process holds (and renews) the
+                # lease for its primary slot and adopts orphaned peers'
+                # slots; the LoopbackBackend is the lease arbiter (its
+                # lease verbs POST the store process's
+                # /apis/v1alpha1/leases/ endpoint, its LEASES mirror is
+                # the slot-watch).
+                from kube_batch_tpu.federation import ShardSlotManager
+
+                self.slot_manager = ShardSlotManager(
+                    self.backend, self.cache,
+                    identity=f"{scheduler_name}-{shard}@{os.getpid()}",
+                    on_owned_change=lambda adopted, removed: (
+                        self.scheduler.on_owned_slots_changed(adopted, removed)
+                    ),
+                )
         else:
             self.cache = SchedulerCache(
                 self.store, scheduler_name=scheduler_name,
@@ -1240,6 +1269,18 @@ class SchedulerServer:
         self.reconcile()
         if self.backend is not None:
             self.backend.start()
+        if self.slot_manager is not None:
+            # Acquire in the background: the cache already owns its
+            # primary slot's filter, and optimistic binds keep a brief
+            # double-ownership overlap correct — so scheduling need not
+            # wait out a reclaim handshake with a survivor that adopted
+            # our slot while we were down.
+            threading.Thread(
+                target=self.slot_manager.start,
+                kwargs={"deadline_s": 3600.0},
+                name="kb-slot-acquire",
+                daemon=True,
+            ).start()
         self._stop.clear()
         t_http = threading.Thread(
             target=self.httpd.serve_forever, name="kb-http", daemon=True
@@ -1253,6 +1294,10 @@ class SchedulerServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.slot_manager is not None:
+            # release owned slots so survivors adopt immediately instead
+            # of waiting out the lease (the graceful half of failover)
+            self.slot_manager.stop(release=True)
         self.watch_hub.close()
         self.httpd.shutdown()
         self.httpd.server_close()

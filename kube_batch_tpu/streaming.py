@@ -205,6 +205,20 @@ class StreamTrigger:
         with self._lock:
             self._gangs.difference_update(done)
 
+    def seed(self, keys) -> None:
+        """Dirty gang keys from OUTSIDE the event feed and wake the
+        loop. Shard-slot adoption uses this: an adopted slot's backlog
+        arrived while another scheduler owned it, so the arrival events
+        either predate this trigger or were dropped by the old filter —
+        seeding makes the next micro-cycle solve exactly the adopted
+        keys against the still-valid resident node table (no full-table
+        invalidate, no full cycle)."""
+        if not keys:
+            return
+        with self._lock:
+            self._gangs.update(keys)
+        self._event.set()
+
     # -- the store's side ----------------------------------------------------
 
     def _mark_stale(self, reason: str) -> None:
