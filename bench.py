@@ -897,6 +897,87 @@ tiers:
     }
 
 
+def federation_wire_runs(
+    gangs: int = 200,
+    members: int = 2,
+    nodes: int = 100,
+    shard_counts: tuple = (1, 2, 4, 8),
+) -> list:
+    """Wire-transport ladder (ISSUE 17): the NETWORKED federation shape —
+    N scheduler processes' worth of LoopbackBackends over one real
+    SchedulerServer on loopback — measured per (protocol, N) cell with
+    the whole topology pinned to wire generation v1 (fresh-connection
+    JSON, per-kind polling, per-gang conditional writes) vs v2 (pooled
+    keep-alive, binary framing, delta long-poll, coalesced gang txns).
+
+    Each cell is one subprocess (``python -m kube_batch_tpu.federation
+    --json --wire-protocol P``): sequential in-process smokes leak
+    scheduler threads and breaker state into each other's clocks, and a
+    fresh interpreter also gives every cell the same cold-start bill.
+    Every cell asserts its own exactly-once + union-parity + fsck bits
+    (they ride the row for bench_diff's parity gate); a cell that fails
+    them fails the bench. Columns: ``binds_per_s`` (wall-clock drain),
+    ``wire_bytes_per_bind`` (protocol bytes both directions / binds),
+    ``backend_rtt_p50_s`` (timed version round-trips), ``txn_batches``/
+    ``txn_batch_mean`` (v2 coalescing depth; structurally 0 under v1).
+    """
+    import subprocess
+
+    runs = []
+    for shards in shard_counts:
+        for proto, codec in ((1, "json"), (2, "binary")):
+            cmd = [
+                sys.executable, "-m", "kube_batch_tpu.federation", "--json",
+                "--wire-protocol", str(proto), "--codec", codec,
+                "--shards", str(shards), "--gangs", str(gangs),
+                "--members", str(members), "--nodes", str(nodes),
+                "--rtt-probes", "16", "--bulk",
+            ]
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            res = subprocess.run(cmd, capture_output=True, text=True, env=env)
+            try:
+                row = json.loads(res.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                raise AssertionError(
+                    f"wire cell v{proto} N={shards} produced no summary "
+                    f"(rc={res.returncode}): {res.stderr[-2000:]}"
+                )
+            assert row.get("ok"), f"wire cell v{proto} N={shards}: {row}"
+            assert row.get("exactly_once") and row.get("union_parity"), (
+                f"wire cell v{proto} N={shards} lost correctness: {row}"
+            )
+            runs.append({
+                "protocol": row["protocol"],
+                "codec": row["codec"],
+                "shards": shards,
+                "pods": row["pods"],
+                "elapsed_s": row["elapsed_s"],
+                "binds_per_s": row["binds_per_s"],
+                "wire_bytes_per_bind": row["wire_bytes_per_bind"],
+                "backend_rtt_p50_s": row["backend_rtt_p50_s"],
+                "txn_batches": row["txn_batches"],
+                "txn_batch_mean": row["txn_batch_mean"],
+                "exactly_once": row["exactly_once"],
+                "union_parity": row["union_parity"],
+                "fsck_clean": not row["fsck_violations"],
+            })
+    # the headline claim, asserted where the numbers are made: at the
+    # contended shard counts the v2 transport must beat its in-row v1
+    # twin on throughput and be strictly leaner per bind
+    by_cell = {(r["protocol"], r["shards"]): r for r in runs}
+    for n in (4, 8):
+        v1, v2 = by_cell[(1, n)], by_cell[(2, n)]
+        assert v2["binds_per_s"] > v1["binds_per_s"], (
+            f"wire N={n}: v2 {v2['binds_per_s']} binds/s did not beat "
+            f"v1 {v1['binds_per_s']}"
+        )
+        assert v2["wire_bytes_per_bind"] < v1["wire_bytes_per_bind"], (
+            f"wire N={n}: v2 bytes/bind {v2['wire_bytes_per_bind']} not "
+            f"below v1 {v1['wire_bytes_per_bind']}"
+        )
+    return runs
+
+
 def main() -> None:
     from kube_batch_tpu.ops import enable_compilation_cache
 
@@ -1451,6 +1532,13 @@ def main() -> None:
     # over one store on a 50k-pod world — aggregate binds/s plus the
     # conflict/retry economics; exactly-once + union fsck asserted per N.
     details["federation_scaleout_50k"] = federation_scaleout_row()
+
+    # Wire-transport ladder (ISSUE 17): the same scale-out shape over
+    # the REAL loopback wire, v1 vs v2 per shard count — binds/s,
+    # bytes/bind, backend RTT and txn coalescing depth, with the v2 >= v1
+    # throughput and strictly-leaner-bytes claims asserted at N=4/8.
+    # bench_diff expands these into <row>.wire_v<p>_n<N> pseudo-rows.
+    details["federation_scaleout_50k"]["wire_runs"] = federation_wire_runs()
 
     # Headline speedup at the headline config (VERDICT r3 item 2).
     serial_50k = e50k.get("serial_s")

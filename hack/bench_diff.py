@@ -44,6 +44,18 @@ overlap fraction and HBM watermark are descriptive telemetry whose
 state rather than per-row latency. Changes in them print as ``[info]``
 lines and do not affect the exit code, even under ``--strict``.
 
+Wire-transport columns (ISSUE 17) are the opposite: they ARE the
+product of their rows, so they gate. A row carrying a ``wire_runs``
+sub-list (the federation scale-out's v1-vs-v2 transport ladder) is
+expanded into one pseudo-row per run, named
+``<row>.wire_v<protocol>_n<shards>``, and within those rows
+``binds_per_s`` and ``txn_batch*`` regress when they SHRINK by more
+than ``--threshold`` while ``wire_bytes_per_bind`` and
+``backend_rtt_*`` regress when they GROW — a v2 transport that slid
+back to v1 throughput or v1 byte volume is a ``regression`` finding,
+not an ``[info]`` line. Their ``exactly_once``/``union_parity`` bits
+join the parity gate.
+
 ``--json`` emits one machine-readable summary line; ``--strict`` exits
 nonzero when any finding fired (default exit is 0 — informational).
 """
@@ -57,12 +69,15 @@ import sys
 
 # latency key preference per row: tail-honest median first
 _LATENCY_KEYS = ("p50_s", "xla_s")
-# true->anything-else is a finding; covers placement parity and the
-# kill-drill MTTR acceptance bit (p50 <= lease TTL + renew period)
+# true->anything-else is a finding; covers placement parity, the
+# kill-drill MTTR acceptance bit (p50 <= lease TTL + renew period) and
+# the wire pseudo-rows' correctness bits
 _PARITY_KEYS = (
     "placements_equal_serial",
     "placements_equal_full_cycle",
     "p50_within_lease_window",
+    "exactly_once",
+    "union_parity",
 )
 _COMPILE_KEYS = ("measured_compiles", "warm_encode_compiles")
 # never-flagged telemetry columns (see module docstring)
@@ -71,10 +86,24 @@ _INFO_KEYS = (
     "pipeline_overlap_fraction",
     "arena_hbm_watermark_bytes",
 )
+# wire-transport columns (see module docstring): gated, with direction.
+# lower-better: bytes and round-trip latency; higher-better: throughput
+# and txn coalescing depth (a batch mean collapsing to 1 means the v2
+# path quietly degraded to per-gang writes).
+_WIRE_LOWER = ("wire_bytes_per_bind",)
+_WIRE_HIGHER = ("binds_per_s",)
 
 
 def _is_info_key(key: str) -> bool:
     return key in _INFO_KEYS or key.startswith("fleet_")
+
+
+def _is_wire_lower(key: str) -> bool:
+    return key in _WIRE_LOWER or key.startswith("backend_rtt_")
+
+
+def _is_wire_higher(key: str) -> bool:
+    return key in _WIRE_HIGHER or key.startswith("txn_batch")
 
 
 def _rows_from_obj(obj):
@@ -124,6 +153,26 @@ def _rows_from_fragment(text: str) -> dict | None:
     return rows or None
 
 
+def _expand_wire_rows(rows: dict) -> dict:
+    """Expand each row's ``wire_runs`` sub-list (the v1-vs-v2 transport
+    ladder on the federation scale-out row) into first-class
+    pseudo-rows named ``<row>.wire_v<protocol>_n<shards>`` so the
+    per-key gates see every (protocol, shard-count) cell."""
+    out = dict(rows)
+    for name, row in rows.items():
+        runs = row.get("wire_runs") if isinstance(row, dict) else None
+        if not isinstance(runs, list):
+            continue
+        for run in runs:
+            if not isinstance(run, dict):
+                continue
+            proto, shards = run.get("protocol"), run.get("shards")
+            if proto is None or shards is None:
+                continue
+            out[f"{name}.wire_v{proto}_n{shards}"] = run
+    return out
+
+
 def load_rows(path: str) -> dict:
     with open(path, encoding="utf-8") as fh:
         obj = json.load(fh)
@@ -134,7 +183,7 @@ def load_rows(path: str) -> dict:
             '{"details": ...} object, a BENCH_*.json wrapper whose tail '
             "embeds one, or a bare row mapping)"
         )
-    return rows
+    return _expand_wire_rows(rows)
 
 
 def _latency(row: dict):
@@ -191,6 +240,27 @@ def diff_rows(old: dict, new: dict, threshold: float) -> dict:
                     "msg": f"{name}: {k} {oc if oc is not None else 0} "
                            f"-> {nc} (measured repeats started compiling)",
                 })
+        for k in sorted(set(o) | set(n)):
+            lower, higher = _is_wire_lower(k), _is_wire_higher(k)
+            if not (lower or higher):
+                continue
+            ow, nw = o.get(k), n.get(k)
+            if not isinstance(ow, (int, float)) or not isinstance(
+                nw, (int, float)
+            ) or ow <= 0:
+                continue
+            delta = (nw - ow) / ow
+            worse = delta > threshold if lower else delta < -threshold
+            better = delta < -threshold if lower else delta > threshold
+            if worse:
+                findings.append({
+                    "row": name, "kind": "regression",
+                    "msg": f"{name}: {k} {ow:g} -> {nw:g} ({delta:+.1%}, "
+                           f"{'lower' if lower else 'higher'}-is-better, "
+                           f"threshold {threshold:.0%})",
+                })
+            elif better:
+                improvements.append(f"{name}: {k} {ow:g} -> {nw:g} ({delta:+.1%})")
         for k in sorted(set(o) | set(n)):
             if not _is_info_key(k):
                 continue

@@ -35,6 +35,11 @@ Runs, in order:
    subsystem — solver, native boundary, cache write, watch hub, lease
    elector — plus a seeded cache-mutation-detector violation, each
    through a real scheduling path, asserting binds still land;
+6b. the wire-codec self-check (python -m kube_batch_tpu.apis.wire
+   --json): seeded property round-trips over every kind — binary
+   (KBW2) and JSON framings must decode back to equal objects, deltas
+   must patch old into new field-for-field, and the binary framing
+   must not be larger than JSON on the aggregate corpus;
 7. the encode-cache parity smoke (python -m kube_batch_tpu.ops.encode_cache):
    warm and 1%-node-churn encodes must be byte-identical to a fresh
    cold encode on a seeded snapshot (KBT_ENCODE_CACHE default-on),
@@ -868,6 +873,32 @@ def main(argv: list[str] | None = None) -> int:
     gates["chaos_smoke"] = {"ok": res.returncode == 0}
     if res.returncode != 0:
         print("verify: chaos smoke FAILED")
+        failed = True
+
+    # 6b. wire-codec self-check: seeded round-trip property pass over
+    # every kind in both framings (python -m kube_batch_tpu.apis.wire).
+    # A codec override armed in the shell must not skew it.
+    env_wc = dict(env)
+    env_wc.pop("KBT_WIRE_CODEC", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.apis.wire", "--json"],
+        cwd=REPO, env=env_wc, capture_output=True, text=True,
+    )
+    wire_summary: dict = {}
+    try:
+        wire_summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    wire_ok = res.returncode == 0 and wire_summary.get("ok", False)
+    gates["wire_codec"] = {
+        "ok": wire_ok,
+        "cases": wire_summary.get("cases"),
+        "json_bytes": wire_summary.get("json_bytes"),
+        "binary_bytes": wire_summary.get("binary_bytes"),
+    }
+    if not wire_ok:
+        print(res.stdout, res.stderr, sep="\n")
+        print("verify: wire codec self-check FAILED")
         failed = True
 
     # 7. encode-cache parity smoke: warm and 1%-churn encodes must be

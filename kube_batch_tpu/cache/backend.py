@@ -34,7 +34,10 @@ refuse-to-schedule guard (KBT_MAX_SNAPSHOT_AGE_S) consumes it via the
 
 from __future__ import annotations
 
+import http.client
 import json
+import os
+import socket
 import threading
 import time
 import urllib.error
@@ -74,6 +77,81 @@ class BackendPartitioned(ConnectionError):
     ``snapshot_age`` grow until the partition heals."""
 
 
+# Keep-alive pool size per backend (wire protocol v2). One connection
+# serves the pump; the rest absorb concurrent write-side dispatches.
+POOL_ENV = "KBT_BACKEND_POOL"
+# Client codec preference (negotiated down to what the server offers).
+CODEC_ENV = "KBT_WIRE_CODEC"
+
+
+def _pool_size() -> int:
+    try:
+        return max(1, int(os.environ.get(POOL_ENV, "") or 4))
+    except ValueError:
+        log.errorf("%s=%r is not an integer; using 4", POOL_ENV, os.environ.get(POOL_ENV))
+        return 4
+
+
+class _ConnectionPool:
+    """Bounded keep-alive ``http.client`` connection pool — the v2
+    transport. Checkout is health-checked (a connection whose socket
+    died idle is discarded, never handed out); a request that fails on
+    a REUSED connection is the keep-alive race (the server closed the
+    socket between our requests) and is retried once on a fresh
+    connection for idempotent GETs only — POSTs surface the failure to
+    the caller's retry ladder, which is conflict-safe by versioning."""
+
+    def __init__(self, host: str, port: int, size: int, timeout: float) -> None:
+        self._host, self._port = host, port
+        self._size = size
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._idle: list[http.client.HTTPConnection] = []
+        self._in_use = 0
+
+    def acquire(self) -> tuple[http.client.HTTPConnection, bool]:
+        """-> (connection, reused). Dead idle sockets are discarded."""
+        conn = None
+        with self._lock:
+            while self._idle:
+                c = self._idle.pop()
+                if c.sock is not None:
+                    conn = c
+                    break
+                c.close()
+            self._in_use += 1
+            in_use = self._in_use
+        metrics.set_backend_pool_in_use(in_use)
+        if conn is not None:
+            return conn, True
+        fresh = http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout
+        )
+        fresh.connect()
+        # TCP_NODELAY: without it, the second request on a kept-alive
+        # connection sits out Nagle vs delayed-ACK (~40ms) — more than
+        # the whole round trip this pool exists to amortize.
+        fresh.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return fresh, False
+
+    def release(self, conn: http.client.HTTPConnection, discard: bool = False) -> None:
+        with self._lock:
+            self._in_use = max(0, self._in_use - 1)
+            in_use = self._in_use
+            if not discard and conn.sock is not None and len(self._idle) < self._size:
+                self._idle.append(conn)
+                conn = None  # type: ignore[assignment]
+        metrics.set_backend_pool_in_use(in_use)
+        if conn is not None:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            c.close()
+
+
 class StoreBackend:
     """The surface SchedulerCache (and its default write-side helpers)
     requires from a cluster store. Documentation-by-interface: both
@@ -108,10 +186,43 @@ class LoopbackBackend:
         base_url: str,
         kinds: tuple = KINDS,
         timeout: float = 5.0,
+        protocol: Optional[int] = None,
+        codec: Optional[str] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.kinds = tuple(kinds)
         self.timeout = timeout
+        # Wire protocol v2 negotiation. `protocol`/`codec` cap what this
+        # client will ASK for; what it actually RUNS is the min with what
+        # the server's /version advertises — a v1-only arbiter answers
+        # with a bare storeVersion and that reply IS the downgrade signal.
+        self._protocol_pref = int(protocol) if protocol else 2
+        self._codec_pref = codec or os.environ.get(CODEC_ENV, "") or "binary"
+        if self._codec_pref not in wire.CODECS:
+            log.errorf(
+                "%s=%r is not one of %s; using json",
+                CODEC_ENV, self._codec_pref, "/".join(wire.CODECS),
+            )
+            self._codec_pref = "json"
+        self._protocol: Optional[int] = None  # None = not yet negotiated
+        self._codec = "json"
+        self._features: frozenset[str] = frozenset()
+        # Any partition (real or injected) forces renegotiation on the
+        # next request: the peer we reconnect to after a partition may be
+        # a different (older or newer) server build.
+        self._needs_negotiation = True
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._pool = _ConnectionPool(
+            parsed.hostname or "localhost",
+            parsed.port or 80,
+            _pool_size(),
+            timeout,
+        )
+        # Cumulative protocol bytes (tx/rx) for bench rows; the metric
+        # family store_backend_bytes_total is process-global, these are
+        # per-backend so a bench can report wire_bytes_per_bind per row.
+        self.bytes_tx = 0
+        self.bytes_rx = 0
         self._lock = threading.RLock()
         self._mirror: dict[str, dict[str, Any]] = {k: {} for k in self.kinds}
         self._handlers: dict[str, list[EventHandler]] = {k: [] for k in self.kinds}
@@ -131,51 +242,181 @@ class LoopbackBackend:
 
     # -- transport ---------------------------------------------------------
 
-    def _request(self, op: str, method: str, path: str, body: Optional[dict] = None):
-        """One metered round-trip. Raises BackendPartitioned on transport
-        failure (injected or real), StaleWrite on a conflict 409."""
+    def _send_urllib(
+        self, method: str, path: str, data: Optional[bytes], headers: dict
+    ) -> tuple[int, str, bytes]:
+        """v1 transport: one urllib round trip per op (pre-v2 semantics,
+        byte-for-byte). OSError propagates — the caller maps it."""
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type", ""), e.read()
+
+    def _send_pooled(
+        self, method: str, path: str, data: Optional[bytes], headers: dict
+    ) -> tuple[int, str, bytes]:
+        """v2 transport: keep-alive round trip on a pooled connection.
+        A failure on a REUSED connection is the keep-alive race (server
+        closed the socket between our requests): retried once on a fresh
+        connection for idempotent GETs only — a POST replayed blind could
+        double-apply a conditional write, so POSTs surface the failure to
+        the version-checked retry ladder instead."""
+        retried = False
+        while True:
+            conn, reused = self._pool.acquire()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+                status, will_close = resp.status, resp.will_close
+            except (http.client.HTTPException, OSError):
+                self._pool.release(conn, discard=True)
+                if reused and method == "GET" and not retried:
+                    retried = True
+                    continue
+                raise
+            self._pool.release(conn, discard=will_close)
+            return status, ctype, raw
+
+    def _negotiate(self) -> None:
+        """Settle protocol/codec/features from GET /backend/v1/version.
+        Always plain-JSON urllib (no pooled transport, no codec
+        assumptions — this must work against any server generation). A
+        v1 server's bare ``{"storeVersion": N}`` reply IS the downgrade
+        signal; no extra round trip, no error path."""
+        status, _, raw = self._send_urllib(
+            "GET", "/backend/v1/version", None, {"Accept": wire.JSON_CONTENT_TYPE}
+        )
+        if status != 200:
+            raise BackendPartitioned(f"negotiate: HTTP {status}")
+        payload = json.loads(raw)
+        proto = min(self._protocol_pref, int(payload.get("protocol", 1)))
+        offered = payload.get("codecs", ["json"]) if proto >= 2 else ["json"]
+        codec = (
+            "binary"
+            if proto >= 2 and self._codec_pref == "binary" and "binary" in offered
+            else "json"
+        )
+        features = frozenset(payload.get("features", ())) if proto >= 2 else frozenset()
+        with self._lock:
+            changed = (proto, codec) != (self._protocol, self._codec)
+            self._protocol, self._codec, self._features = proto, codec, features
+            self._needs_negotiation = False
+            if "storeVersion" in payload:
+                self._store_version = max(
+                    self._store_version, int(payload["storeVersion"])
+                )
+        if changed:
+            log.infof(
+                "store backend %s negotiated protocol v%d codec=%s features=%s",
+                self.base_url, proto, codec, ",".join(sorted(features)) or "-",
+            )
+
+    def _mark_renegotiate(self) -> None:
+        """The peer we talk to next may be a different server generation
+        (partition heal, arbiter restart, rolling upgrade) — re-run
+        version negotiation before the next request."""
+        with self._lock:
+            self._needs_negotiation = True
+
+    def _request(
+        self,
+        op: str,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        not_found_ok: bool = False,
+    ):
+        """One metered round-trip over the negotiated transport. Raises
+        BackendPartitioned on transport failure (injected or real),
+        StaleWrite on a conflict 409, _Unsupported on a 404 the caller
+        opted into (v2-only route against a v1 server)."""
         if faults.should_fire("federation.partition"):
+            self._mark_renegotiate()
             raise BackendPartitioned(
                 f"federation.partition: injected transport drop ({op})"
             )
-        data = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
+        with self._lock:
+            negotiate = self._protocol is None or self._needs_negotiation
+        if negotiate:
+            try:
+                self._negotiate()
+            except OSError as e:
+                raise BackendPartitioned(f"{op}: negotiate: {e}") from e
+        with self._lock:
+            proto, codec = self._protocol or 1, self._codec
+        if body is not None:
+            if codec == "binary":
+                data = wire.dumps_binary(body)
+                req_ctype = wire.BINARY_CONTENT_TYPE
+            else:
+                data = json.dumps(body).encode()
+                req_ctype = wire.JSON_CONTENT_TYPE
+        else:
+            data, req_ctype = None, wire.JSON_CONTENT_TYPE
+        headers = {"Content-Type": req_ctype}
+        if proto >= 2:
+            headers["Accept"] = (
+                wire.BINARY_CONTENT_TYPE if codec == "binary"
+                else wire.JSON_CONTENT_TYPE
+            )
         # trace propagation (kube_batch_tpu.obs): the current span's ids
         # ride as headers so the store arbiter's server-side span joins
         # this scheduler's trace — a federated conflict's full retry
         # story renders as ONE trace across N processes
         headers.update(obs.current_headers())
-        req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=data,
-            headers=headers,
-            method=method,
-        )
         start = time.perf_counter()
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read())
-            except Exception:  # noqa: BLE001 - non-JSON error body
-                payload = {}
-            if e.code == 409 and "conflict" in payload:
-                c = payload["conflict"]
-                raise StaleWrite(
-                    c.get("kind", ""),
-                    c.get("key", ""),
-                    c.get("reason", "conflict"),
-                    int(c.get("expected", 0)),
-                    int(c.get("actual", 0)),
-                ) from None
-            if e.code == 410:
-                raise _Gone(int(payload.get("resourceVersion", 0))) from None
-            raise BackendPartitioned(f"{op}: HTTP {e.code}") from e
+            send = self._send_pooled if proto >= 2 else self._send_urllib
+            status, resp_ctype, raw = send(method, path, data, headers)
         except OSError as e:  # connection refused/reset, timeout
+            self._mark_renegotiate()
             raise BackendPartitioned(f"{op}: {e}") from e
         finally:
             metrics.observe_store_backend_rtt(op, time.perf_counter() - start)
+        rx_codec = (
+            "binary" if wire.BINARY_CONTENT_TYPE in (resp_ctype or "") else "json"
+        )
+        if data is not None:
+            metrics.register_store_backend_bytes(
+                "tx", "binary" if req_ctype == wire.BINARY_CONTENT_TYPE else "json",
+                len(data),
+            )
+        metrics.register_store_backend_bytes("rx", rx_codec, len(raw))
+        with self._lock:
+            self.bytes_tx += len(data) if data is not None else 0
+            self.bytes_rx += len(raw)
+        try:
+            if rx_codec == "binary":
+                payload = wire.loads_binary(raw)
+            else:
+                payload = json.loads(raw) if raw else {}
+        except ValueError as e:
+            if status == 200:
+                self._mark_renegotiate()
+                raise BackendPartitioned(f"{op}: undecodable reply: {e}") from e
+            payload = {}
+        if status == 409 and isinstance(payload, dict) and "conflict" in payload:
+            c = payload["conflict"]
+            raise StaleWrite(
+                c.get("kind", ""),
+                c.get("key", ""),
+                c.get("reason", "conflict"),
+                int(c.get("expected", 0)),
+                int(c.get("actual", 0)),
+            )
+        if status == 410:
+            raise _Gone(int(payload.get("resourceVersion", 0)))
+        if status == 404 and not_found_ok:
+            raise _Unsupported(path)
+        if status >= 400:
+            self._mark_renegotiate()
+            raise BackendPartitioned(f"{op}: HTTP {status}")
         if isinstance(payload, dict) and "storeVersion" in payload:
             with self._lock:
                 self._store_version = max(
@@ -219,8 +460,78 @@ class LoopbackBackend:
 
     def pump(self, timeout: float = 0.0) -> int:
         """One deterministic poll pass over every subscribed kind;
-        returns the number of events dispatched. A partition skips the
-        round (mirror stales, snapshot_age grows) instead of raising."""
+        returns the number of events dispatched. Under negotiated
+        protocol v2 this is a single combined long-poll (watchall) whose
+        MODIFIED events arrive as field-level deltas; under v1 it is the
+        original per-kind cursor poll. A partition skips the round
+        (mirror stales, snapshot_age grows) instead of raising."""
+        with self._lock:
+            use_v2 = (
+                self._protocol is not None
+                and not self._needs_negotiation
+                and self._protocol >= 2
+                and "longpoll" in self._features
+            )
+        if use_v2:
+            try:
+                return self._pump_v2(timeout)
+            except _Unsupported:
+                # Mid-run downgrade: the arbiter we reconnected to after a
+                # partition is v1-only. Renegotiate, fall back this round.
+                self._mark_renegotiate()
+        return self._pump_v1(timeout)
+
+    def _apply_events(self, kind: str, events: list[dict]) -> int:
+        """Decode wire payloads OUTSIDE the mirror lock — a fat gang's
+        payload decode under ``_lock`` would stall every concurrent
+        mirror read (snapshot, conflict resync) for the duration — then
+        apply the prepared batch under it. Delta events (v2) patch the
+        mirror object in place; a delta for a key the mirror doesn't
+        hold means its ADDED was missed — heal by re-list."""
+        prepared: list[tuple] = []
+        for ev in events:
+            if "delta" in ev:
+                prepared.append(("patch", ev["delta"]))
+            elif ev["type"] == "DELETED" and "object" not in ev:
+                prepared.append(("delkey", ev["key"]))
+            else:
+                prepared.append((ev["type"], wire.decode_kind(kind, ev["object"])))
+        need_relist = False
+        batch: list[tuple] = []
+        with self._lock:
+            mirror = self._mirror[kind]
+            for verb, arg in prepared:
+                if verb == "patch":
+                    key = arg["key"]
+                    old = mirror.get(key)
+                    if old is None:
+                        need_relist = True
+                        continue
+                    new = wire.apply_delta(kind, old, arg)
+                    mirror[key] = new
+                    batch.append(("update", old, new))
+                elif verb == "delkey" or verb == "DELETED":
+                    key = arg if verb == "delkey" else obj_key(kind, arg)
+                    old = mirror.pop(key, None)
+                    if old is not None:
+                        batch.append(("delete", old, None))
+                else:
+                    obj = arg
+                    key = obj_key(kind, obj)
+                    old = mirror.get(key)
+                    mirror[key] = obj
+                    batch.append(
+                        ("add", None, obj) if old is None else ("update", old, obj)
+                    )
+            handlers = list(self._handlers[kind])
+        dispatched = self._dispatch(handlers, batch)
+        if need_relist:
+            dispatched += self._relist(kind)
+        return dispatched
+
+    def _pump_v1(self, timeout: float = 0.0) -> int:
+        """Per-kind cursor poll — the pre-v2 pass, byte-for-byte on the
+        wire (full objects, one request per kind)."""
         dispatched = 0
         try:
             for kind in self.kinds:
@@ -240,26 +551,46 @@ class LoopbackBackend:
                     # transition exactly once from their point of view.
                     dispatched += self._relist(kind)
                     continue
-                events = payload.get("events", [])
-                batch: list[tuple] = []
+                dispatched += self._apply_events(kind, payload.get("events", []))
                 with self._lock:
-                    for ev in events:
-                        obj = wire.decode_kind(kind, ev["object"])
-                        key = obj_key(kind, obj)
-                        old = self._mirror[kind].get(key)
-                        if ev["type"] == "DELETED":
-                            if old is not None:
-                                del self._mirror[kind][key]
-                                batch.append(("delete", old, None))
-                        elif old is None:
-                            self._mirror[kind][key] = obj
-                            batch.append(("add", None, obj))
-                        else:
-                            self._mirror[kind][key] = obj
-                            batch.append(("update", old, obj))
                     self._cursor[kind] = int(payload["resourceVersion"])
-                    handlers = list(self._handlers[kind])
-                dispatched += self._dispatch(handlers, batch)
+        except BackendPartitioned as e:
+            log.V(3).infof("backend pump skipped: %s", e)
+            return dispatched
+        self._last_pump_ok = time.monotonic()
+        return dispatched
+
+    def _pump_v2(self, timeout: float = 0.0) -> int:
+        """One combined long-poll over every synced kind: the server
+        parks the request until ANY kind has events past its cursor, so
+        an idle federation costs one parked request per window instead
+        of len(kinds) polls per period. Raises _Unsupported on 404 (v1
+        server behind this URL now) for pump() to downgrade."""
+        with self._lock:
+            cursors = {k: self._cursor[k] for k in self.kinds if self._synced[k]}
+            delta = "delta" in self._features
+        if not cursors:
+            return 0
+        qs = ",".join(f"{k}:{since}" for k, since in cursors.items())
+        path = f"/backend/v1/watchall?cursors={qs}&timeout={timeout}"
+        if delta:
+            path += "&delta=1"
+        dispatched = 0
+        try:
+            payload = self._request("watch", "GET", path, not_found_ok=True)
+            rv = int(payload["resourceVersion"])
+            for kind, res in payload.get("kinds", {}).items():
+                if kind not in self._mirror:
+                    continue
+                if res.get("status") == "gone":
+                    dispatched += self._relist(kind)
+                    continue
+                dispatched += self._apply_events(kind, res.get("events", []))
+                # rv was read under the same hub lock that collected
+                # every kind's events — safe to advance all polled
+                # cursors to it in one go.
+                with self._lock:
+                    self._cursor[kind] = rv
         except BackendPartitioned as e:
             log.V(3).infof("backend pump skipped: %s", e)
             return dispatched
@@ -304,10 +635,20 @@ class LoopbackBackend:
         if self._thread is not None:
             return
         self._stop.clear()
+        # v2 long-poll window: park on the server as long as possible
+        # while staying safely under the transport read timeout (or
+        # urlopen/pool would kill an intentionally-parked request).
+        longpoll = max(period, min(10.0, max(0.5, self.timeout - 1.0)))
 
         def loop() -> None:
             while not self._stop.is_set():
-                self.pump(timeout=period)
+                with self._lock:
+                    parked = (
+                        self._protocol is not None
+                        and self._protocol >= 2
+                        and "longpoll" in self._features
+                    )
+                self.pump(timeout=longpoll if parked else period)
 
         self._thread = threading.Thread(target=loop, name="kb-backend", daemon=True)
         self._thread.start()
@@ -317,6 +658,7 @@ class LoopbackBackend:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._pool.close()
 
     def snapshot_age(self) -> float:
         """Seconds since the last fully-successful pump — the
@@ -372,6 +714,42 @@ class LoopbackBackend:
              "snapshotVersion": snapshot_version},
         )
         return payload.get("evicted")
+
+    # -- coalesced conditional txns (wire protocol v2) ---------------------
+
+    def supports_txn(self) -> bool:
+        """True when the negotiated protocol carries /backend/v1/txn.
+        False before first contact or after a partition — the cache
+        falls back to per-gang writes until negotiation settles."""
+        with self._lock:
+            return (
+                self._protocol is not None
+                and not self._needs_negotiation
+                and self._protocol >= 2
+                and "txn" in self._features
+            )
+
+    def submit_txn(self, txns: list[dict]) -> list[dict]:
+        """Batch of conditional txns in ONE round trip; returns per-txn
+        results (``{"applied": N}`` | ``{"evicted": bool}`` |
+        ``{"conflict": {...}}``) in submission order. A 404 means the
+        server downgraded mid-run: renegotiate and surface a partition
+        so the caller degrades to per-gang v1 writes."""
+        try:
+            payload = self._request(
+                "txn", "POST", "/backend/v1/txn", {"txns": txns}, not_found_ok=True
+            )
+        except _Unsupported:
+            self._mark_renegotiate()
+            raise BackendPartitioned(
+                "txn: endpoint gone (server downgraded?); renegotiating"
+            ) from None
+        results = payload.get("results", [])
+        if len(results) != len(txns):
+            raise BackendPartitioned(
+                f"txn: {len(results)} results for {len(txns)} txns"
+            )
+        return results
 
     def _lease_verb(self, name: str, verb: str, body: dict) -> Any:
         """POST the arbiter's lease endpoint and reconstruct the Lease
@@ -491,3 +869,13 @@ class _Gone(Exception):
     def __init__(self, rv: int) -> None:
         super().__init__(f"410 gone (rv {rv})")
         self.rv = rv
+
+
+class _Unsupported(Exception):
+    """Internal: a v2-only route 404ed — the server behind this URL is a
+    v1 generation (rolling downgrade, partition heal to an older peer).
+    Callers renegotiate and take their v1 path."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__(f"unsupported route {path} (v1 server?)")
+        self.path = path

@@ -535,6 +535,13 @@ class SchedulerCache:
                 os.environ.get("KBT_CONFLICT_MAX_RETRIES"),
             )
             self._conflict_max_retries = 3
+        # Coalesced conditional writes (wire protocol v2): every gang
+        # dispatched by one cycle rides ONE /backend/v1/txn round trip
+        # (all-or-nothing per gang, per-txn conflict results) when the
+        # negotiated backend supports it. Off -> per-gang round trips.
+        self._txn_coalesce = os.environ.get(
+            "KBT_TXN_COALESCE", "1"
+        ).lower() not in ("", "0", "false")
         # Store version this cache's latest snapshot solved over — the
         # version every conditional dispatch carries (#: guarded_by _mutex
         # for writes; dispatch reads the int atomically).
@@ -1098,11 +1105,101 @@ class SchedulerCache:
             gangs: dict[str, list] = {}
             for entry in resolved:
                 gangs.setdefault(entry[2].job, []).append(entry)
+            # Coalescing (wire protocol v2): every gang this cycle
+            # dispatched rides one /backend/v1/txn round trip instead of
+            # one RTT per gang. Gangs stay all-or-nothing — the batch is
+            # transport-level only; a conflicted gang falls back to the
+            # per-gang retry ladder with a fresh version.
+            supports = getattr(self.store, "supports_txn", None)
+            if (
+                self._txn_coalesce
+                and len(gangs) > 1
+                and callable(supports)
+                and supports()
+            ):
+                self._do_bind_txn(gangs, ctx)
+                return
             for gang in gangs.values():
                 self._do_bind_gang(gang, ctx)
             return
         for pod, hostname, task, seq in resolved:
             self._do_bind(pod, hostname, task, seq)
+
+    def _do_bind_txn(self, gangs: dict, ctx=None) -> None:
+        """Dispatch every gang of this cycle in ONE coalesced store txn.
+        Exactly-once is per gang, exactly as in the per-gang path: each
+        txn carries its own snapshot version, an applied gang confirms
+        its own journal seqs, a conflicted gang re-enters
+        ``_do_bind_gang``'s retry ladder (which refreshes the version),
+        and a transport failure mid-batch degrades LOUDLY to per-gang v1
+        writes — whose conditional versions make any server-side partial
+        application resolve to store truth, never a double bind."""
+        order = list(gangs.values())
+        txns = []
+        for entries in order:
+            version = self._snapshot_version
+            if faults.should_fire("federation.stale_assign"):
+                version = 0  # deliberately ancient: forces the conflict path
+            txns.append(
+                {
+                    "op": "bind",
+                    "bindings": [
+                        [pod.namespace, pod.name, hostname]
+                        for pod, hostname, _task, _seq in entries
+                    ],
+                    "snapshotVersion": version,
+                }
+            )
+        pods = sum(len(e) for e in order)
+        with obs.span(
+            "txn.batch", parent=ctx, gangs=len(order), pods=pods
+        ) as tspan:
+            if faults.should_fire("store.txn_batch"):
+                results = None
+            else:
+                try:
+                    results = self.store.submit_txn(txns)
+                except Exception as e:  # noqa: BLE001 - any batch failure degrades
+                    log.errorf("coalesced txn batch failed (%s)", e)
+                    results = None
+            if results is None:
+                tspan.set_attr("outcome", "degraded")
+                log.errorf(
+                    "degrading %d gang(s) to per-gang conditional writes",
+                    len(order),
+                )
+                for entries in order:
+                    self._do_bind_gang(entries, ctx)
+                return
+            conflicts = 0
+            for entries, result in zip(order, results):
+                if "conflict" not in result:
+                    metrics.register_federation_conflict(
+                        "clean", exemplar=tspan.trace_id
+                    )
+                    for _pod, _hostname, _task, seq in entries:
+                        self._journal_confirm(seq)
+                    continue
+                conflicts += 1
+                c = result["conflict"]
+                what = f"gang <{entries[0][2].job}> ({len(entries)} pod(s))"
+                for node in sorted(
+                    {h for _p, h, _t, _s in entries}
+                ):
+                    metrics.register_federation_node_conflict(node)
+                metrics.register_federation_conflict(
+                    "retried", exemplar=tspan.trace_id
+                )
+                metrics.register_bind_retry()
+                log.warningf(
+                    "bind of %s conflicted in coalesced txn (%s %s: %s), "
+                    "re-dispatching per-gang",
+                    what, c.get("kind", ""), c.get("key", ""),
+                    c.get("reason", "conflict"),
+                )
+                self._do_bind_gang(entries, ctx)
+            tspan.set_attr("outcome", "ok")
+            tspan.set_attr("conflicts", conflicts)
 
     def _do_bind_gang(self, entries: list, ctx=None) -> None:
         """Dispatch one gang as a conditional store transaction carrying

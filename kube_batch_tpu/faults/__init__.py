@@ -100,6 +100,7 @@ POINTS = (
     "pipeline.fence",   # deferred dispatch wedged -> fence timeout -> sync degrade
     # sharded federation (cache/store.py, cache/backend.py, federation.py)
     "store.conflict",      # conditional write rejected -> loser resyncs gang + retries
+    "store.txn_batch",     # coalesced txn round trip fails -> per-gang v1 writes, loudly
     "federation.partition",  # loopback backend transport drops -> backoff + relist heal
     "federation.stale_assign",  # dispatch carries a stale snapshot version on purpose
     # leased shard slots (federation.py ShardSlotManager)

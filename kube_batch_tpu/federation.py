@@ -1004,7 +1004,16 @@ def _wait_all_bound(store, total: int, deadline_s: float) -> bool:
     return False
 
 
-def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> dict:
+def smoke(
+    shards: int = 2,
+    gangs: int = 6,
+    members: int = 3,
+    nodes: int = 8,
+    protocol: Optional[int] = None,
+    codec: Optional[str] = None,
+    rtt_probes: int = 0,
+    bulk: bool = False,
+) -> dict:
     """End-to-end federation proof, runnable standalone
     (``python -m kube_batch_tpu.federation``) and from hack/verify.py:
 
@@ -1018,7 +1027,24 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
        capacity-valid (fsck clean), and the *set* of bound pods matches
        a single-scheduler twin on an identical world (which pods bind
        is deterministic; which node wins a race is not).
-    """
+
+    ``protocol``/``codec`` pin the wire generation for bench rows:
+    ``protocol=1`` runs the whole topology on the pre-v2 surface
+    (server pinned, clients capped), ``protocol=2`` the full v2 stack.
+    When pinned, the result additionally carries the measured row —
+    ``binds_per_s``, ``wire_bytes_per_bind`` (protocol bytes both
+    directions over total binds), ``backend_rtt_p50_s`` (``rtt_probes``
+    timed version round-trips: fresh-connection urllib under v1, pooled
+    keep-alive under v2) and server-side txn batch stats.
+
+    ``bulk=True`` runs every scheduler (and the parity twin) on the
+    gang bulk-dispatch conf (``enqueue, xla_allocate`` with the device
+    size floor pinned off) so binds flow through ``bind_many`` — the
+    path that opens all-or-nothing gang transactions and, under v2,
+    coalesces the cycle's gangs into one ``/backend/v1/txn`` round
+    trip. The default serial conf binds per task and never batches."""
+    import statistics
+    import tempfile
     import threading
 
     from kube_batch_tpu.cache import EventHandler, LoopbackBackend
@@ -1026,9 +1052,33 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
     from kube_batch_tpu.server import SchedulerServer
 
     total = gangs * members
+    # bulk-dispatch conf: no O(cluster) fairness sweeps, and the device
+    # size floor pinned off so small worlds still route through
+    # bind_many's gang transactions instead of per-pod serial dispatch
+    conf_path = None
+    saved_floor = os.environ.get("KBT_MIN_DEVICE_PAIRS")
+    if bulk:
+        os.environ["KBT_MIN_DEVICE_PAIRS"] = "0"
+        fh = tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", prefix="kbt-fed-", delete=False
+        )
+        fh.write(
+            'actions: "enqueue, xla_allocate"\n'
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: priority\n"
+            "  - name: gang\n"
+            "  - name: conformance\n"
+            "- plugins:\n"
+            "  - name: predicates\n"
+            "  - name: nodeorder\n"
+        )
+        fh.close()
+        conf_path = fh.name
     server = SchedulerServer(
         scheduler_name="store-arbiter", listen_address="127.0.0.1:0",
         schedule_period=60.0,
+        wire_protocol=1 if protocol == 1 else 2,
     )
     server.start()
     bind_counts: dict[str, int] = {}
@@ -1044,11 +1094,17 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
     backends: list[LoopbackBackend] = []
     scheds: list[tuple[Scheduler, threading.Thread]] = []
     stop = threading.Event()
+    txn0 = metrics.store_backend_txn_batch.snapshot()
+    rtts: list[float] = []
+    negotiated: tuple = (None, None)
+    wire_bytes = 0
+    elapsed = 0.0
     try:
         _seed_world(server.store, gangs, members, nodes)
         base = f"http://127.0.0.1:{server.listen_port}"
+        t0 = time.monotonic()
         for i in range(shards):
-            backend = LoopbackBackend(base)
+            backend = LoopbackBackend(base, protocol=protocol, codec=codec)
             cache = FederatedCache(
                 backend, shard=i, shards=shards, shard_key="gang",
                 staleness_fn=backend.snapshot_age,
@@ -1056,13 +1112,20 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
             cache.run()
             backend.start(period=0.02)
             backends.append(backend)
-            sched = Scheduler(cache, schedule_period=0.05)
+            sched = Scheduler(
+                cache, scheduler_conf=conf_path, schedule_period=0.05
+            )
             t = threading.Thread(
                 target=sched.run, args=(stop,), name=f"kb-fed-{i}", daemon=True
             )
             t.start()
             scheds.append((sched, t))
         all_bound = _wait_all_bound(server.store, total, deadline_s=60.0)
+        elapsed = time.monotonic() - t0
+        for _ in range(max(0, rtt_probes)):
+            p0 = time.perf_counter()
+            backends[0].version
+            rtts.append(time.perf_counter() - p0)
     finally:
         stop.set()
         for _, t in scheds:
@@ -1071,6 +1134,9 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
             backend.stop()
         for sched, _ in scheds:
             sched.cache.stop()
+        if backends:
+            negotiated = (backends[0]._protocol, backends[0]._codec)
+            wire_bytes = sum(b.bytes_tx + b.bytes_rx for b in backends)
         server.stop()
 
     violations = fsck(server.store)
@@ -1084,7 +1150,9 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
     _seed_world(twin, gangs, members, nodes)
     twin_cache = SchedulerCache(twin)
     twin_cache.run()
-    twin_sched = Scheduler(twin_cache, schedule_period=0.02)
+    twin_sched = Scheduler(
+        twin_cache, scheduler_conf=conf_path, schedule_period=0.02
+    )
     twin_stop = threading.Event()
     t = threading.Thread(target=twin_sched.run, args=(twin_stop,), daemon=True)
     t.start()
@@ -1094,6 +1162,15 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
         twin_stop.set()
         t.join(timeout=10.0)
         twin_cache.stop()
+        if bulk:
+            if saved_floor is None:
+                os.environ.pop("KBT_MIN_DEVICE_PAIRS", None)
+            else:
+                os.environ["KBT_MIN_DEVICE_PAIRS"] = saved_floor
+            try:
+                os.unlink(conf_path)
+            except OSError:
+                pass
     fed_bound = {
         f"{p.namespace}/{p.name}"
         for p in server.store.list(PODS)
@@ -1112,6 +1189,28 @@ def smoke(shards: int = 2, gangs: int = 6, members: int = 3, nodes: int = 8) -> 
         "fsck_violations": violations,
         "union_parity": fed_bound == twin_bound,
     }
+    if protocol is not None:
+        txn1 = metrics.store_backend_txn_batch.snapshot()
+        batches = txn1["count"] - txn0["count"]
+        out.update(
+            {
+                "protocol": negotiated[0],
+                "codec": negotiated[1],
+                "elapsed_s": round(elapsed, 4),
+                "binds_per_s": (
+                    round(total / elapsed, 2) if elapsed > 0 else 0.0
+                ),
+                "wire_bytes_per_bind": round(wire_bytes / max(1, total), 1),
+                "backend_rtt_p50_s": (
+                    round(statistics.median(rtts), 6) if rtts else None
+                ),
+                "txn_batches": batches,
+                "txn_batch_mean": (
+                    round((txn1["sum"] - txn0["sum"]) / batches, 2)
+                    if batches else 0.0
+                ),
+            }
+        )
     out["ok"] = bool(
         all_bound
         and exactly_once
@@ -1418,6 +1517,26 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--shards", type=int, default=None)
     parser.add_argument("--gangs", type=int, default=None)
     parser.add_argument("--members", type=int, default=None)
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument(
+        "--wire-protocol", type=int, default=None, choices=(1, 2),
+        help="pin the wire generation (1 = pre-v2 surface end to end, "
+        "2 = full v2 stack) and emit the measured transport row",
+    )
+    parser.add_argument(
+        "--codec", default=None, choices=("json", "binary"),
+        help="with --wire-protocol: the client codec preference",
+    )
+    parser.add_argument(
+        "--rtt-probes", type=int, default=0,
+        help="with --wire-protocol: timed version round-trips for the "
+        "backend_rtt_p50_s column",
+    )
+    parser.add_argument(
+        "--bulk", action="store_true",
+        help="schedule on the gang bulk-dispatch conf (bind_many -> "
+        "gang transactions; v2 coalesces them per cycle)",
+    )
     parser.add_argument(
         "--kill-one", action="store_true",
         help="kill-and-adopt drill: SIGKILL one shard owner mid-bind_many "
@@ -1444,6 +1563,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             shards=args.shards or 2,
             gangs=args.gangs or 6,
             members=args.members or 3,
+            nodes=args.nodes or 8,
+            protocol=args.wire_protocol,
+            codec=args.codec,
+            rtt_probes=args.rtt_probes,
+            bulk=args.bulk,
         )
     if args.json:
         print(json.dumps(result, sort_keys=True))

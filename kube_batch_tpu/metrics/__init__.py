@@ -377,6 +377,33 @@ store_backend_rtt = Histogram(
     FINE_BUCKETS,
 )
 
+# -- wire protocol v2 (cache/backend.py pooled transport) --------------------
+# Power-of-two batch-size buckets: txn batches are small integers, not
+# latencies, so the 5us-anchored FINE_BUCKETS would collapse them all
+# into +Inf.
+BATCH_BUCKETS = tuple(2.0**k for k in range(12))
+store_backend_bytes = Counter(
+    f"{_SUBSYSTEM}_store_backend_bytes_total",
+    "Store-backend protocol bytes moved, by direction (tx/rx) and "
+    "negotiated codec (json/binary)",
+)
+store_backend_txn_batch = Histogram(
+    f"{_SUBSYSTEM}_store_backend_txn_batch_size",
+    "Conditional-write transactions coalesced per /backend/v1/txn "
+    "round trip",
+    BATCH_BUCKETS,
+)
+backend_pool_in_use = Gauge(
+    f"{_SUBSYSTEM}_backend_pool_in_use",
+    "Persistent store-backend connections currently checked out of the "
+    "keep-alive pool (KBT_BACKEND_POOL bounds the pool)",
+)
+watch_longpoll_wakeups = Counter(
+    f"{_SUBSYSTEM}_watch_longpoll_wakeups_total",
+    "Long-poll watch returns on the v2 combined endpoint, by cause "
+    "(events/timeout)",
+)
+
 # -- leased shard slots (kube_batch_tpu.federation ShardSlotManager) ---------
 # Dynamic shard ownership: each of the N shard slots is a store lease;
 # a scheduler holds its primary slot, adopts orphaned ones, and hands
@@ -657,6 +684,22 @@ def observe_store_backend_rtt(op: str, seconds: float) -> None:
     store_backend_rtt.observe(seconds, {"op": op})
 
 
+def register_store_backend_bytes(direction: str, codec: str, n: int) -> None:
+    store_backend_bytes.inc({"dir": direction, "codec": codec}, by=n)
+
+
+def observe_txn_batch_size(n: int) -> None:
+    store_backend_txn_batch.observe(float(n))
+
+
+def set_backend_pool_in_use(n: int) -> None:
+    backend_pool_in_use.set(n)
+
+
+def register_longpoll_wakeup(cause: str) -> None:
+    watch_longpoll_wakeups.inc({"cause": cause})
+
+
 def set_shard_slots_owned(n: int) -> None:
     shard_slots_owned.set(n)
 
@@ -867,6 +910,10 @@ def render_prometheus_text() -> str:
         federation_node_conflicts,
         bind_retries,
         store_backend_rtt,
+        store_backend_bytes,
+        store_backend_txn_batch,
+        backend_pool_in_use,
+        watch_longpoll_wakeups,
         shard_slots_owned,
         shard_slot_owned,
         shard_adoptions,

@@ -112,7 +112,9 @@ SPAN_NAMES = (
     "journal.append", # write-intent journal append (seqs as attr)
     "dispatch",       # cache.bind_many host side: resolve+journal+submit
     "gang.bind",      # one gang's store write, conflict retries as events
+    "txn.batch",      # coalesced multi-gang conditional-write round trip
     "store.bind",     # store-arbiter side of a conditional bind (remote)
+    "store.txn",      # store-arbiter side of a coalesced txn batch (remote)
     "time_to_bind",   # synthetic: streaming arrival -> bind echo, per pod
     "explain",        # post-solve unschedulability forensics (obs/explain)
 )

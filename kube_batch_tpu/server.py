@@ -180,12 +180,14 @@ class WatchHub:
                 kind,
                 EventHandler(
                     on_add=lambda obj, k=kind: self._emit(k, "ADDED", obj),
-                    on_update=lambda old, new, k=kind: self._emit(k, "MODIFIED", new),
+                    on_update=lambda old, new, k=kind: self._emit(
+                        k, "MODIFIED", new, old
+                    ),
                     on_delete=lambda obj, k=kind: self._emit(k, "DELETED", obj),
                 ),
             )
 
-    def _emit(self, kind: str, verb: str, obj) -> None:
+    def _emit(self, kind: str, verb: str, obj, old=None) -> None:
         if not self._active:
             # Double-checked under the lock; pre-activation events only
             # bump the counter (nobody is owed them).
@@ -197,16 +199,20 @@ class WatchHub:
         # time per consumer (observability summary vs the full-fidelity
         # wire codec for store backends). Store objects are replaced, not
         # mutated (the mutation detector enforces it), so a late poll
-        # serializes exactly the state the event captured.
+        # serializes exactly the state the event captured. MODIFIED
+        # entries also carry the replaced object so a v2 delta consumer
+        # can be served the field-level patch; the patch itself is
+        # computed lazily at first delta poll and cached in the entry
+        # (slot 4) — computed once per event, not per consumer, and
+        # never on the mutation hot path.
         with self._cond:
             self._seq += 1
             ring = self._events[kind]
             if len(ring) >= self.max_events:
                 # true 410 on overflow: the dropped seq fences every
                 # watcher holding an rv at or before it into a re-list
-                seq, _, _ = ring.popleft()
-                self._dropped[kind] = seq
-            ring.append((self._seq, verb, obj))
+                self._dropped[kind] = ring.popleft()[0]
+            ring.append([self._seq, verb, obj, old, None])
             self._cond.notify_all()
 
     def close(self) -> None:
@@ -226,6 +232,47 @@ class WatchHub:
             self._activate_locked()
             return self._seq
 
+    def _event_payload(self, kind: str, entry: list, ser, delta: bool) -> dict:
+        """Serialize one ring entry for a consumer. ``delta`` (v2 wire
+        consumers only) turns MODIFIED into a field-level patch and
+        DELETED into a bare key tombstone; ADDED always carries the
+        full object (the client has nothing to patch)."""
+        seq, verb, obj, old, cached = entry
+        if delta and verb == "MODIFIED" and old is not None:
+            patch = cached
+            if patch is None:
+                from kube_batch_tpu.apis.wire import delta_of
+
+                from kube_batch_tpu.cache.store import obj_key
+
+                patch = {"key": obj_key(kind, obj)}
+                patch.update(delta_of(kind, old, obj))
+                entry[4] = patch  # computed once per event, cached
+            return {"seq": seq, "type": verb, "delta": patch}
+        if delta and verb == "DELETED":
+            from kube_batch_tpu.cache.store import obj_key
+
+            return {"seq": seq, "type": verb, "key": obj_key(kind, obj)}
+        return {"seq": seq, "type": verb, "object": ser(obj)}
+
+    def _collect_locked(
+        self, kind: str, since: int, wire: bool, delta: bool
+    ) -> list[dict]:
+        """Events past ``since`` for one kind. Ring entries are
+        seq-ascending: walk from the right only as far as `since` —
+        O(new events), not O(ring). Caller holds ``_cond``."""
+        if wire:
+            from kube_batch_tpu.apis.wire import to_wire as ser
+        else:
+            ser = SERIALIZERS[kind]
+        batch: list[dict] = []
+        for entry in reversed(self._events[kind]):
+            if entry[0] <= since:
+                break
+            batch.append(self._event_payload(kind, entry, ser, delta))
+        batch.reverse()
+        return batch
+
     def poll(
         self,
         kind: str,
@@ -233,39 +280,70 @@ class WatchHub:
         timeout: float,
         stop: threading.Event,
         wire: bool = False,
+        delta: bool = False,
     ) -> tuple[str, list[dict], int]:
         """("ok" | "gone", events, resourceVersion). Blocks up to
         `timeout` seconds for the first event past `since`. ``wire``
         selects the full-fidelity codec (apis/wire.py, store backends)
-        over the observability summary serializer."""
+        over the observability summary serializer; ``delta`` (v2)
+        additionally compresses MODIFIED events into field patches."""
         if faults.should_fire("watch.drop"):
             # Injected stream drop: the 410-Gone contract — the client
             # must re-list and resume from the returned resourceVersion.
             with self._cond:
                 return "gone", [], self._seq
-        if wire:
-            from kube_batch_tpu.apis.wire import to_wire as ser
-        else:
-            ser = SERIALIZERS[kind]
         deadline = time.monotonic() + timeout
         while True:
             with self._cond:
                 self._activate_locked()
                 if since < max(self._dropped.get(kind, 0), self._journal_start):
                     return "gone", [], self._seq
-                # Ring entries are seq-ascending: walk from the right only
-                # as far as `since` — O(new events), not O(ring).
-                batch: list[dict] = []
-                for seq, verb, obj in reversed(self._events[kind]):
-                    if seq <= since:
-                        break
-                    batch.append({"seq": seq, "type": verb, "object": ser(obj)})
+                batch = self._collect_locked(kind, since, wire, delta)
                 if batch:
-                    batch.reverse()
                     return "ok", batch, self._seq
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or stop.is_set() or self._closed:
                     return "ok", [], self._seq
+                self._cond.wait(min(remaining, 1.0))
+
+    def poll_multi(
+        self,
+        cursors: dict[str, int],
+        timeout: float,
+        stop: threading.Event,
+        delta: bool = False,
+    ) -> tuple[dict[str, dict], int]:
+        """The v2 combined long-poll: one blocking call over EVERY
+        subscribed kind's cursor, returning the moment ANY kind has an
+        event past its cursor — the client's pump thread blocks here on
+        the server instead of walking kinds with per-kind timeouts.
+        Returns ``({kind: {"status": "ok"|"gone", "events": [...]}},
+        resourceVersion)``; per-kind gone (ring overflow) rides inline
+        so one fallen-behind kind re-lists without aborting the rest.
+        Always the full-fidelity wire codec (backend consumers only)."""
+        if faults.should_fire("watch.drop"):
+            with self._cond:
+                return (
+                    {k: {"status": "gone", "events": []} for k in cursors},
+                    self._seq,
+                )
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cond:
+                self._activate_locked()
+                out: dict[str, dict] = {}
+                ready = False
+                for kind, since in cursors.items():
+                    if since < max(self._dropped.get(kind, 0), self._journal_start):
+                        out[kind] = {"status": "gone", "events": []}
+                        ready = True
+                        continue
+                    batch = self._collect_locked(kind, since, True, delta)
+                    out[kind] = {"status": "ok", "events": batch}
+                    ready = ready or bool(batch)
+                remaining = deadline - time.monotonic()
+                if ready or remaining <= 0 or stop.is_set() or self._closed:
+                    return out, self._seq
                 self._cond.wait(min(remaining, 1.0))
 
 
@@ -529,16 +607,59 @@ class StoreLeaseElector:
 
 def _make_handler(server: "SchedulerServer"):
     class Handler(BaseHTTPRequestHandler):
+        # Wire protocol v2: HTTP/1.1 keep-alive so the backend client's
+        # connection pool reuses sockets across requests (_reply always
+        # sends Content-Length, which 1.1 persistence requires). A
+        # v1-pinned server keeps http.server's 1.0 default — one
+        # connection per op, exactly the pre-v2 wire behavior.
+        if getattr(server, "wire_protocol", 2) >= 2:
+            protocol_version = "HTTP/1.1"
+            # TCP_NODELAY (socketserver applies this per connection in
+            # StreamRequestHandler.setup): without it every
+            # reused-connection round trip sits out the Nagle vs
+            # delayed-ACK interaction (~40ms) — more latency than the
+            # whole RTT the keep-alive transport exists to amortize.
+            disable_nagle_algorithm = True
+
         def log_message(self, fmt, *args):  # route http.server chatter to V(4)
             log.V(4).infof("http: " + fmt, *args)
 
         def _reply(self, code: int, body: str, ctype: str = "application/json") -> None:
-            data = body.encode()
+            self._reply_bytes(code, body.encode(), ctype)
+
+        def _reply_bytes(self, code: int, data: bytes, ctype: str) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+
+        def _wants_binary(self) -> bool:
+            """Content negotiation for /backend/v1/ replies: the client
+            advertises the binary codec in Accept; a v1-pinned server
+            never honors it (the client then sees JSON come back and
+            keeps speaking JSON — negotiation by response)."""
+            from kube_batch_tpu.apis.wire import BINARY_CONTENT_TYPE
+
+            return server.wire_protocol >= 2 and BINARY_CONTENT_TYPE in (
+                self.headers.get("Accept") or ""
+            )
+
+        def _backend_reply(self, code: int, payload: dict) -> None:
+            """Serialize a /backend/v1/ reply in the negotiated codec.
+            Error replies stay JSON on purpose: a mixed-version client
+            must be able to read a 404/409/410 before (or without)
+            codec agreement."""
+            from kube_batch_tpu.apis import wire as wire_mod
+
+            if code == 200 and self._wants_binary():
+                self._reply_bytes(
+                    code,
+                    wire_mod.dumps_binary(payload),
+                    wire_mod.BINARY_CONTENT_TYPE,
+                )
+            else:
+                self._reply(code, json.dumps(payload))
 
         def do_GET(self):  # noqa: N802 (http.server API)
             parsed = urllib.parse.urlsplit(self.path)
@@ -602,8 +723,63 @@ def _make_handler(server: "SchedulerServer"):
                 self._reply(200, json.dumps(obs_explain.debug_payload(gang)))
             elif path == "/backend/v1/version":
                 # Store-backend protocol (cache/backend.py): the store
-                # version optimistic writes are checked against.
-                self._reply(200, json.dumps({"storeVersion": server.store.version}))
+                # version optimistic writes are checked against. A v2
+                # server additionally advertises its protocol level and
+                # capabilities here — the client's one negotiation read;
+                # a v1 server's bare reply IS the downgrade signal.
+                payload = {"storeVersion": server.store.version}
+                if server.wire_protocol >= 2:
+                    payload.update(
+                        {
+                            "protocol": 2,
+                            "codecs": ["json", "binary"],
+                            "features": ["delta", "txn", "longpoll"],
+                        }
+                    )
+                self._backend_reply(200, payload)
+            elif path == "/backend/v1/watchall":
+                # v2 combined long-poll (absent under a v1 pin: the 404
+                # sends a v2 client back to per-kind polling).
+                if server.wire_protocol < 2:
+                    self._reply(404, json.dumps({"error": "not found"}))
+                    return
+                query = urllib.parse.parse_qs(parsed.query)
+                import math
+
+                try:
+                    timeout = float(query.get("timeout", ["30"])[0])
+                    cursors = {}
+                    for part in query.get("cursors", [""])[0].split(","):
+                        if not part:
+                            continue
+                        kind, _, since = part.partition(":")
+                        if kind not in SERIALIZERS:
+                            raise ValueError(kind)
+                        cursors[kind] = int(since or "0")
+                except ValueError:
+                    self._reply(400, json.dumps({"error": "bad cursors/timeout"}))
+                    return
+                if not math.isfinite(timeout):
+                    self._reply(400, json.dumps({"error": "bad cursors/timeout"}))
+                    return
+                timeout = min(max(timeout, 0.0), 300.0)
+                delta = query.get("delta", ["0"])[0] not in ("", "0", "false")
+                kinds, rv = server.watch_hub.poll_multi(
+                    cursors, timeout, server._stop, delta=delta
+                )
+                metrics.register_longpoll_wakeup(
+                    "events"
+                    if any(k["events"] or k["status"] == "gone" for k in kinds.values())
+                    else "timeout"
+                )
+                self._backend_reply(
+                    200,
+                    {
+                        "kinds": kinds,
+                        "resourceVersion": rv,
+                        "storeVersion": server.store.version,
+                    },
+                )
             elif path.startswith("/backend/v1/watch/"):
                 kind = path[len("/backend/v1/watch/"):]
                 if kind not in SERIALIZERS:
@@ -622,16 +798,19 @@ def _make_handler(server: "SchedulerServer"):
                     self._reply(400, json.dumps({"error": "bad since/timeout"}))
                     return
                 timeout = min(max(timeout, 0.0), 300.0)
+                delta = server.wire_protocol >= 2 and query.get("delta", ["0"])[
+                    0
+                ] not in ("", "0", "false")
                 status, events, rv = server.watch_hub.poll(
-                    kind, since, timeout, server._stop, wire=True
+                    kind, since, timeout, server._stop, wire=True, delta=delta
                 )
                 if status == "gone":
                     self._reply(
                         410, json.dumps({"error": "too old", "resourceVersion": rv})
                     )
                     return
-                self._reply(
-                    200, json.dumps({"events": events, "resourceVersion": rv})
+                self._backend_reply(
+                    200, {"events": events, "resourceVersion": rv}
                 )
             elif path.startswith("/backend/v1/"):
                 from kube_batch_tpu.apis.wire import to_wire
@@ -645,15 +824,13 @@ def _make_handler(server: "SchedulerServer"):
                 rv = server.watch_hub.resource_version
                 store_v = server.store.version
                 items = [to_wire(obj) for obj in server.store.list(kind)]
-                self._reply(
+                self._backend_reply(
                     200,
-                    json.dumps(
-                        {
-                            "items": items,
-                            "resourceVersion": rv,
-                            "storeVersion": store_v,
-                        }
-                    ),
+                    {
+                        "items": items,
+                        "resourceVersion": rv,
+                        "storeVersion": store_v,
+                    },
                 )
             elif path.startswith("/apis/v1alpha1/watch/"):
                 kind = path[len("/apis/v1alpha1/watch/"):]
@@ -705,7 +882,18 @@ def _make_handler(server: "SchedulerServer"):
 
         def _read_body(self) -> dict:
             length = int(self.headers.get("Content-Length", "0"))
-            return json.loads(self.rfile.read(length) or b"{}")
+            raw = self.rfile.read(length) or b"{}"
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            from kube_batch_tpu.apis.wire import BINARY_CONTENT_TYPE, loads_binary
+
+            if ctype == BINARY_CONTENT_TYPE:
+                if server.wire_protocol < 2:
+                    raise ValueError(
+                        "binary request body on a v1 server (re-negotiate: "
+                        "GET /backend/v1/version)"
+                    )
+                return loads_binary(raw)
+            return json.loads(raw)
 
         def _backend_post(self, tail: str, body: dict) -> None:
             """Store-backend mutation surface (cache/backend.py client).
@@ -718,18 +906,21 @@ def _make_handler(server: "SchedulerServer"):
             """
             from kube_batch_tpu.apis import wire
 
+            def parse_bindings(raw) -> list:
+                if not isinstance(raw, list):
+                    raise ValueError("bindings must be a list")
+                bindings = []
+                for entry in raw:
+                    if not (isinstance(entry, (list, tuple)) and len(entry) == 3):
+                        raise ValueError(
+                            "each binding must be [namespace, name, hostname]"
+                        )
+                    bindings.append(tuple(str(x) for x in entry))
+                return bindings
+
             try:
                 if tail == "bind":
-                    raw = body.get("bindings")
-                    if not isinstance(raw, list):
-                        raise ValueError("bindings must be a list")
-                    bindings = []
-                    for entry in raw:
-                        if not (isinstance(entry, (list, tuple)) and len(entry) == 3):
-                            raise ValueError(
-                                "each binding must be [namespace, name, hostname]"
-                            )
-                        bindings.append(tuple(str(x) for x in entry))
+                    bindings = parse_bindings(body.get("bindings"))
                     version = int(body.get("snapshotVersion", 0))
                     # Store-side half of the distributed bind trace: the
                     # client (cache/backend.py) sends its gang.bind span
@@ -746,14 +937,73 @@ def _make_handler(server: "SchedulerServer"):
                             bindings, version
                         )
                         bspan.set_attr("applied", len(applied))
-                    self._reply(
+                    self._backend_reply(
                         200,
-                        json.dumps(
-                            {
-                                "applied": len(applied),
-                                "storeVersion": server.store.version,
-                            }
-                        ),
+                        {
+                            "applied": len(applied),
+                            "storeVersion": server.store.version,
+                        },
+                    )
+                elif tail == "txn":
+                    # v2 coalesced conditional writes: one round trip, a
+                    # batch of per-gang transactions, per-transaction 409
+                    # results inline (the HTTP status stays 200 — one
+                    # conflicted gang must not fail its batchmates).
+                    if server.wire_protocol < 2:
+                        self._reply(404, json.dumps({"error": "not found"}))
+                        return
+                    txns = body.get("txns")
+                    if not isinstance(txns, list):
+                        raise ValueError("txns must be a list")
+                    results = []
+                    with obs.span(
+                        "store.txn",
+                        parent=obs.from_headers(self.headers),
+                        txns=len(txns),
+                    ) as tspan:
+                        for txn in txns:
+                            if not isinstance(txn, dict):
+                                raise ValueError("each txn must be an object")
+                            op = txn.get("op")
+                            version = int(txn.get("snapshotVersion", 0))
+                            try:
+                                if op == "bind":
+                                    applied = server.store.conditional_bind_many(
+                                        parse_bindings(txn.get("bindings")), version
+                                    )
+                                    results.append({"applied": len(applied)})
+                                elif op in ("evict", "unbind"):
+                                    old = server.store.conditional_evict(
+                                        str(txn.get("namespace", "")),
+                                        str(txn.get("name", "")),
+                                        version,
+                                    )
+                                    results.append({"evicted": old is not None})
+                                else:
+                                    raise ValueError(f"unknown txn op {op!r}")
+                            except StaleWrite as e:
+                                results.append(
+                                    {
+                                        "conflict": {
+                                            "kind": e.kind,
+                                            "key": e.key,
+                                            "reason": e.reason,
+                                            "expected": e.expected,
+                                            "actual": e.actual,
+                                        }
+                                    }
+                                )
+                        tspan.set_attr(
+                            "conflicts",
+                            sum(1 for r in results if "conflict" in r),
+                        )
+                    metrics.observe_txn_batch_size(len(txns))
+                    self._backend_reply(
+                        200,
+                        {
+                            "results": results,
+                            "storeVersion": server.store.version,
+                        },
                     )
                 elif tail == "evict":
                     namespace = str(body.get("namespace", ""))
@@ -762,14 +1012,12 @@ def _make_handler(server: "SchedulerServer"):
                         raise ValueError("name must be non-empty")
                     version = int(body.get("snapshotVersion", 0))
                     old = server.store.conditional_evict(namespace, name, version)
-                    self._reply(
+                    self._backend_reply(
                         200,
-                        json.dumps(
-                            {
-                                "evicted": old is not None,
-                                "storeVersion": server.store.version,
-                            }
-                        ),
+                        {
+                            "evicted": old is not None,
+                            "storeVersion": server.store.version,
+                        },
                     )
                 elif tail in SERIALIZERS:
                     verb = body.get("verb")
@@ -786,8 +1034,8 @@ def _make_handler(server: "SchedulerServer"):
                         server.store.delete(tail, key)
                     else:
                         raise ValueError(f"unknown verb {verb!r}")
-                    self._reply(
-                        200, json.dumps({"storeVersion": server.store.version})
+                    self._backend_reply(
+                        200, {"storeVersion": server.store.version}
                     )
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
@@ -1148,8 +1396,16 @@ class SchedulerServer:
         store: Optional[ClusterStore] = None,
         journal_path: Optional[str] = None,
         store_backend_url: Optional[str] = None,
+        wire_protocol: int = 2,
     ) -> None:
         import os
+
+        # Store-backend wire protocol this server SPEAKS (not what any
+        # client negotiated): 2 advertises delta watch / txn batches /
+        # the binary codec on /backend/v1/version and serves HTTP/1.1
+        # keep-alive; 1 pins the pre-v2 surface byte-for-byte (mixed-
+        # version drills and the bench's v1 twin rows pass 1 here).
+        self.wire_protocol = int(wire_protocol)
 
         # Federation mode (--store-backend): this process schedules over
         # a remote store's /backend/v1/ protocol instead of owning an

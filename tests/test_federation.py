@@ -697,3 +697,105 @@ def test_chaos_partition_skips_pump_and_heals(arbiter):
     backend.pump()
     assert backend.get_pod("default", "g0-p0").node_name == "n0"
     assert fsck(arbiter.store) == []
+
+
+# -- mixed-version federation (wire protocol v2, ISSUE 17) -------------------
+
+
+def test_v2_client_negotiates_down_against_v1_server():
+    """A v2 client against a v1-only arbiter: the bare storeVersion
+    reply IS the downgrade signal — no error path, no extra round trip,
+    and every v1 surface (list, watch, conditional writes) keeps
+    working byte-for-byte."""
+    srv = SchedulerServer(
+        scheduler_name="store-arbiter", listen_address="127.0.0.1:0",
+        schedule_period=60.0, wire_protocol=1,
+    )
+    srv.start()
+    try:
+        seed_store(srv.store, gangs=("g0",), members=2)
+        backend = LoopbackBackend(f"http://127.0.0.1:{srv.listen_port}")
+        events: list[str] = []
+        backend.add_event_handler(
+            PODS, EventHandler(on_add=lambda o: events.append(o.name))
+        )
+        assert backend._protocol == 1 and backend._codec == "json"
+        assert not backend.supports_txn()  # the cache's coalescing gate
+        assert sorted(events) == ["g0-p0", "g0-p1"]
+        v = backend.version
+        assert backend.conditional_bind_many(
+            [("default", "g0-p0", "n0")], v
+        ) == 1
+        assert backend.pump() >= 1  # per-kind v1 polls, full objects
+        assert backend.get_pod("default", "g0-p0").node_name == "n0"
+        assert fsck(srv.store) == []
+    finally:
+        srv.stop()
+
+
+def test_v1_pinned_client_against_v2_server(arbiter):
+    """The other direction of the matrix: an old (protocol-capped)
+    client against a v2 arbiter runs the negotiated minimum — v1,
+    json — and the server never pushes v2 surfaces at it."""
+    seed_store(arbiter.store, gangs=("g0",), members=2)
+    backend = LoopbackBackend(
+        f"http://127.0.0.1:{arbiter.listen_port}", protocol=1
+    )
+    backend.add_event_handler(PODS, EventHandler())
+    assert backend._protocol == 1 and backend._codec == "json"
+    assert not backend.supports_txn()
+    v = backend.version
+    assert backend.conditional_bind_many([("default", "g0-p0", "n0")], v) == 1
+    assert backend.pump() >= 1
+    assert backend.get_pod("default", "g0-p0").node_name == "n0"
+    assert fsck(arbiter.store) == []
+
+
+def test_partition_forces_renegotiation_midrun(arbiter):
+    """After any partition (injected or real) the peer we reconnect to
+    may be a different server generation: the backend must drop its
+    negotiated state and re-run version negotiation before the next
+    request — and still deliver the events the dropped round missed."""
+    seed_store(arbiter.store, gangs=("g0",), members=1)
+    backend = _backend_for(arbiter)
+    backend.add_event_handler(PODS, EventHandler())
+    assert backend._protocol == 2 and backend.supports_txn()
+    faults.registry.arm("federation.partition", count=1)
+    arbiter.store.create_pod(
+        build_pod(name="px", req=build_resource_list(cpu=1))
+    )
+    assert backend.pump() == 0  # dropped round
+    assert backend._needs_negotiation
+    assert not backend.supports_txn()  # coalescing gate closes until settled
+    # next pass renegotiates first, then delivers the missed event
+    assert backend.pump() >= 1
+    assert backend._protocol == 2 and backend.supports_txn()
+    assert backend.get_pod("default", "px") is not None
+
+
+def test_rolling_downgrade_midrun_renegotiates_down(arbiter):
+    """Rolling downgrade drill: the arbiter behind the same URL flips
+    to v1 mid-run. The client's watchall 404s (_Unsupported), the SAME
+    pump falls back to per-kind v1 polling — renegotiating on the way —
+    and conditional writes keep landing; when the arbiter comes back as
+    v2 the client upgrades again on its next negotiation."""
+    seed_store(arbiter.store, gangs=("g0",), members=2)
+    backend = _backend_for(arbiter)
+    backend.add_event_handler(PODS, EventHandler())
+    assert backend._protocol == 2 and backend.supports_txn()
+    arbiter.wire_protocol = 1  # same listener, older build
+    arbiter.store.create_pod(
+        build_pod(name="px", req=build_resource_list(cpu=1))
+    )
+    assert backend.pump() >= 1  # watchall 404 -> v1 fallback, same round
+    assert backend.get_pod("default", "px") is not None
+    assert backend._protocol == 1 and backend._codec == "json"
+    assert not backend.supports_txn()
+    v = backend.version
+    assert backend.conditional_bind_many([("default", "g0-p0", "n0")], v) == 1
+    # heal: the arbiter rolls forward again
+    arbiter.wire_protocol = 2
+    backend._mark_renegotiate()
+    assert backend.version == arbiter.store.version
+    assert backend._protocol == 2 and backend.supports_txn()
+    assert fsck(arbiter.store) == []

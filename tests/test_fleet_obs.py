@@ -486,6 +486,84 @@ def test_bench_diff_info_does_not_mask_real_regression():
     assert len(summary["info"]) == 1
 
 
+# -- bench_diff: wire-transport columns gate (ISSUE 17) ----------------------
+
+
+def _wire_run(proto, shards, binds_per_s, bytes_per_bind, rtt, batch_mean):
+    return {
+        "protocol": proto, "shards": shards, "binds_per_s": binds_per_s,
+        "wire_bytes_per_bind": bytes_per_bind, "backend_rtt_p50_s": rtt,
+        "txn_batch_mean": batch_mean, "exactly_once": True,
+        "union_parity": True,
+    }
+
+
+def test_bench_diff_catches_v2_throughput_slide_back_to_v1():
+    """The wire ladder's whole point: a v2 cell whose throughput slid
+    back to v1 numbers (and whose byte volume grew back) must be a
+    regression finding in the expanded pseudo-row — flagged, not an
+    [info] line — even though the parent row's p50 is unchanged."""
+    bd = _bench_diff_mod()
+    old = {"federation_scaleout_50k": {
+        "p50_s": 5.0,
+        "wire_runs": [
+            _wire_run(1, 4, 60.0, 15000.0, 0.009, 0.0),
+            _wire_run(2, 4, 85.0, 8600.0, 0.007, 50.0),
+        ],
+    }}
+    # v2 n4 collapses to v1-grade throughput/bytes; coalescing depth
+    # falls to per-gang (txn_batch_mean 50 -> 1); rtt doubles
+    new = {"federation_scaleout_50k": {
+        "p50_s": 5.0,
+        "wire_runs": [
+            _wire_run(1, 4, 60.0, 15000.0, 0.009, 0.0),
+            _wire_run(2, 4, 41.0, 14800.0, 0.014, 1.0),
+        ],
+    }}
+    summary = bd.diff_rows(
+        bd._expand_wire_rows(old), bd._expand_wire_rows(new), threshold=0.15
+    )
+    assert summary["ok"] is False
+    v2_findings = [
+        f for f in summary["findings"]
+        if f["row"] == "federation_scaleout_50k.wire_v2_n4"
+    ]
+    assert {f["kind"] for f in v2_findings} == {"regression"}
+    flagged = " ".join(f["msg"] for f in v2_findings)
+    assert "binds_per_s" in flagged          # higher-is-better shrank
+    assert "wire_bytes_per_bind" in flagged  # lower-is-better grew
+    assert "backend_rtt_p50_s" in flagged
+    assert "txn_batch_mean" in flagged
+    # the untouched v1 twin cell stays quiet
+    assert not any(
+        f["row"] == "federation_scaleout_50k.wire_v1_n4"
+        for f in summary["findings"]
+    )
+
+
+def test_bench_diff_wire_parity_bits_and_improvements():
+    bd = _bench_diff_mod()
+    old = {"fed": {"wire_runs": [_wire_run(2, 8, 50.0, 16000.0, 0.01, 25.0)]}}
+    better = {"fed": {"wire_runs": [_wire_run(2, 8, 70.0, 9000.0, 0.006, 25.0)]}}
+    summary = bd.diff_rows(
+        bd._expand_wire_rows(old), bd._expand_wire_rows(better), threshold=0.15
+    )
+    assert summary["ok"] is True and summary["findings"] == []
+    assert any("binds_per_s" in line for line in summary["improvements"])
+    # a correctness bit going false is a parity finding no number excuses
+    broken = {"fed": {"wire_runs": [
+        dict(_wire_run(2, 8, 70.0, 9000.0, 0.006, 25.0), exactly_once=False)
+    ]}}
+    summary = bd.diff_rows(
+        bd._expand_wire_rows(old), bd._expand_wire_rows(broken), threshold=0.15
+    )
+    assert summary["ok"] is False
+    assert any(
+        f["kind"] == "parity" and "exactly_once" in f["msg"]
+        for f in summary["findings"]
+    )
+
+
 # -- measured pipeline overlap -----------------------------------------------
 
 

@@ -1,0 +1,457 @@
+"""Wire protocol v2 (ISSUE 17): the binary framing's loud failure
+modes, the pump's decode-outside-lock contract, delta list+watch mirror
+parity (including the 410 re-list heal), and the coalesced conditional
+write path — bind-for-bind parity with per-gang dispatch under a
+mutation detector, zero journal orphans after a SIGKILL mid-batch, and
+the ``store.txn_batch`` chaos drill degrading loudly to per-gang v1
+writes."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu import faults, log, metrics
+from kube_batch_tpu.api.job_info import job_key
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.apis import wire
+from kube_batch_tpu.cache import (
+    EventHandler,
+    LoopbackBackend,
+    SchedulerCache,
+)
+from kube_batch_tpu.cache.store import KINDS, PODS, QUEUES
+from kube_batch_tpu.faults.mutation_detector import MutationDetector
+from kube_batch_tpu.federation import fsck
+from kube_batch_tpu.recovery import WriteIntentJournal, reconcile_journal
+from kube_batch_tpu.server import SchedulerServer
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+    yield
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+
+
+@pytest.fixture
+def make_arbiter():
+    """Factory for store-arbiter servers (scheduling loop idled by a
+    scheduler name no workload pod carries); stops them all at teardown
+    so a failing test never leaks a listener thread."""
+    servers: list[SchedulerServer] = []
+
+    def _make(wire_protocol: int = 2) -> SchedulerServer:
+        srv = SchedulerServer(
+            scheduler_name="store-arbiter",
+            listen_address="127.0.0.1:0",
+            schedule_period=60.0,
+            wire_protocol=wire_protocol,
+        )
+        srv.start()
+        servers.append(srv)
+        return srv
+
+    yield _make
+    for srv in servers:
+        srv.stop()
+
+
+def _base(arbiter) -> str:
+    return f"http://127.0.0.1:{arbiter.listen_port}"
+
+
+def seed_store(store, nodes=1, cpu=16, gangs=(), members=3):
+    if store.get(QUEUES, "default") is None:  # the server pre-seeds one
+        store.create_queue(build_queue("default"))
+    for i in range(nodes):
+        store.create_node(
+            build_node(
+                f"n{i}", build_resource_list(cpu=cpu, memory=f"{cpu}Gi", pods=64)
+            )
+        )
+    for g in gangs:
+        store.create_pod_group(build_pod_group(g, min_member=members))
+        for m in range(members):
+            store.create_pod(
+                build_pod(
+                    name=f"{g}-p{m}", group_name=g,
+                    req=build_resource_list(cpu=1, memory="512Mi"),
+                )
+            )
+
+
+def bind_gangs(cache, mapping: dict):
+    """Dispatch every pending task of each gang in ONE bind_many call —
+    the shape the coalescer batches: all gangs of one cycle, one txn
+    round trip."""
+    pairs = []
+    with cache._mutex:
+        for gang, node in mapping.items():
+            job = cache.jobs.get(job_key("default", gang))
+            pending = (
+                list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
+                if job is not None
+                else []
+            )
+            assert pending, f"gang {gang} has no pending tasks in this cache"
+            pairs.extend((t, node) for t in pending)
+    cache.bind_many(pairs)
+
+
+def count_bind_events(store):
+    counts: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def on_update(old, new):
+        if not old.node_name and new.node_name:
+            with lock:
+                key = f"{new.namespace}/{new.name}"
+                counts[key] = counts.get(key, 0) + 1
+
+    store.add_event_handler(PODS, EventHandler(on_update=on_update))
+    return counts
+
+
+def mirror_snap(backend) -> dict:
+    """Canonical bytes of a backend mirror: kind -> key -> sorted wire
+    JSON. Two mirrors fed through different transports (full-object v1
+    vs delta v2, json vs binary) must be byte-identical here."""
+    with backend._lock:
+        return {
+            kind: {
+                key: json.dumps(wire.encode_kind(kind, obj), sort_keys=True)
+                for key, obj in backend._mirror[kind].items()
+            }
+            for kind in backend.kinds
+        }
+
+
+# -- binary framing ----------------------------------------------------------
+
+
+def test_binary_codec_self_check_and_size_win():
+    s = wire.self_check(seed=1, cases=20)
+    assert s["ok"], s["errors"]
+    assert s["failures"] == 0
+    # the headline property of the binary framing: strictly fewer bytes
+    # than the same objects through the JSON codec
+    assert s["binary_bytes"] < s["json_bytes"]
+
+
+def test_binary_frame_rejects_garbage_loudly():
+    # JSON bytes handed to the binary decoder: the codec-mismatch case —
+    # the error must point at the triage ladder, not be a struct error
+    with pytest.raises(ValueError, match="codec mismatch"):
+        wire.loads_binary(b'{"storeVersion": 3}')
+    blob = wire.dumps_binary({"a": 1, "b": [1, 2, 3]})
+    assert wire.loads_binary(blob) == {"a": 1, "b": [1, 2, 3]}
+    with pytest.raises(ValueError, match="length mismatch"):
+        wire.loads_binary(blob[:-2])
+    with pytest.raises(ValueError, match="codec mismatch"):
+        wire.loads_binary(b"XXXX" + blob[4:])
+
+
+def test_bad_codec_pref_falls_back_to_json():
+    # an unknown KBT_WIRE_CODEC must degrade to json (loudly, in the
+    # log), never crash the backend at construction
+    b = LoopbackBackend("http://127.0.0.1:9", codec="gzip")
+    assert b._codec_pref == "json"
+
+
+def test_binary_body_to_v1_server_is_rejected_loudly(make_arbiter):
+    # a v2 client that skipped renegotiation after a rolling downgrade
+    # would POST binary at a v1 server: the reply must be a loud 400
+    # JSON error naming the fix, not a silent mis-parse
+    srv = make_arbiter(wire_protocol=1)
+    req = urllib.request.Request(
+        f"{_base(srv)}/backend/v1/bind",
+        data=wire.dumps_binary({"bindings": []}),
+        headers={"Content-Type": wire.BINARY_CONTENT_TYPE},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+    payload = json.loads(ei.value.read())
+    assert "binary request body on a v1 server" in payload["error"]
+    assert "re-negotiate" in payload["error"]
+
+
+# -- pump lock discipline (satellite: decode outside _lock) ------------------
+
+
+def test_pump_decodes_wire_payloads_outside_mirror_lock(
+    make_arbiter, monkeypatch
+):
+    """Regression for the pump stall: decoding a fat payload under
+    ``_lock`` blocks every concurrent mirror read for the duration.
+    Every ``decode_kind`` call (initial list, watch events, re-list
+    heal) must run with the mirror lock acquirable from another thread
+    — probed cross-thread because ``_lock`` is an RLock and a
+    same-thread acquire would always succeed."""
+    srv = make_arbiter()
+    seed_store(srv.store, gangs=("g0",), members=2)
+    backend = LoopbackBackend(_base(srv), kinds=(PODS,))
+
+    real_decode = wire.decode_kind
+    probes: list[bool] = []
+
+    def spying_decode(kind, data):
+        acquired = []
+
+        def probe():
+            ok = backend._lock.acquire(timeout=2.0)
+            if ok:
+                backend._lock.release()
+            acquired.append(ok)
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join(timeout=5.0)
+        probes.extend(acquired or [False])
+        return real_decode(kind, data)
+
+    monkeypatch.setattr(wire, "decode_kind", spying_decode)
+    backend.add_event_handler(PODS, EventHandler())  # initial list decodes
+    srv.store.create_pod(
+        build_pod(name="late", req=build_resource_list(cpu=1))
+    )
+    assert backend.pump() >= 1  # watch event decodes
+    faults.registry.arm("watch.drop", count=1)
+    srv.store.create_pod(
+        build_pod(name="later", req=build_resource_list(cpu=1))
+    )
+    assert backend.pump() >= 1  # 410 -> re-list heal decodes
+    assert probes, "decode_kind never ran — the drill lost its subject"
+    assert all(probes), "mirror lock was held during a wire decode"
+    assert backend.get_pod("default", "later") is not None
+
+
+# -- delta list+watch --------------------------------------------------------
+
+
+def test_delta_mirror_matches_full_object_mirror_through_schedule(
+    make_arbiter,
+):
+    """Acceptance drill: a delta-watch (v2, binary) mirror must be
+    byte-identical to a full-object (v1, json) mirror after the same
+    event schedule — adds, gang binds, deletes, and a forced 410 heal
+    mid-run."""
+    srv = make_arbiter()
+    seed_store(srv.store, nodes=2, gangs=("g0", "g1"), members=2)
+    b_full = LoopbackBackend(_base(srv), protocol=1)
+    b_delta = LoopbackBackend(_base(srv))
+    for b in (b_full, b_delta):
+        for kind in KINDS:
+            b.add_event_handler(kind, EventHandler())
+    assert b_delta._protocol == 2 and "delta" in b_delta._features
+    assert b_full._protocol == 1
+    assert mirror_snap(b_delta) == mirror_snap(b_full)
+
+    # adds + a gang bind (field-level MODIFIED deltas on pods/nodes)
+    srv.store.create_pod(
+        build_pod(name="late", req=build_resource_list(cpu=1))
+    )
+    v = srv.store.version
+    srv.store.conditional_bind_many(
+        [("default", "g0-p0", "n0"), ("default", "g0-p1", "n0")], v
+    )
+    srv.store.delete_pod("default", "late")
+    assert b_delta.pump() >= 1
+    assert b_full.pump() >= 1
+    snap = mirror_snap(b_delta)
+    assert snap == mirror_snap(b_full)
+    assert json.loads(snap[PODS]["default/g0-p0"])["node_name"] == "n0"
+    assert "default/late" not in snap[PODS]
+
+    # 410 heal: the delta watcher's cursor is declared gone mid-run;
+    # it must re-list and land on the exact same bytes as the v1 twin
+    faults.registry.arm("watch.drop", count=1)
+    v = srv.store.version
+    srv.store.conditional_bind_many(
+        [("default", "g1-p0", "n1"), ("default", "g1-p1", "n1")], v
+    )
+    assert b_delta.pump() >= 1  # consumes the fault: gone -> re-list
+    assert b_full.pump() >= 1
+    snap = mirror_snap(b_delta)
+    assert snap == mirror_snap(b_full)
+    assert json.loads(snap[PODS]["default/g1-p1"])["node_name"] == "n1"
+    # and both match server truth
+    for g, n in (("g0", "n0"), ("g1", "n1")):
+        for m in range(2):
+            assert b_delta.get_pod("default", f"{g}-p{m}").node_name == n
+
+
+# -- coalesced conditional writes --------------------------------------------
+
+
+def _cache_over(srv, **kwargs) -> SchedulerCache:
+    cache = SchedulerCache(
+        LoopbackBackend(_base(srv)), conditional_binds=True, **kwargs
+    )
+    cache.snapshot()  # stamp _snapshot_version for conditional dispatch
+    return cache
+
+
+GANG_NODES = {"ga": "n0", "gb": "n1", "gc": "n2"}
+
+
+def test_coalesced_txn_parity_with_per_gang_dispatch(
+    make_arbiter, monkeypatch
+):
+    """Acceptance drill: the same three-gang cycle through the coalesced
+    /backend/v1/txn path and through per-gang conditional writes must
+    land bind-for-bind identical placements — exactly once, mutation
+    detector armed, fsck clean — with exactly one batch observed."""
+    srv_txn = make_arbiter()
+    srv_gang = make_arbiter()
+    for srv in (srv_txn, srv_gang):
+        seed_store(srv.store, nodes=3, gangs=tuple(GANG_NODES), members=2)
+    counts_txn = count_bind_events(srv_txn.store)
+    counts_gang = count_bind_events(srv_gang.store)
+    det_txn = MutationDetector(srv_txn.store)
+    det_gang = MutationDetector(srv_gang.store)
+    det_txn.snapshot()
+    det_gang.snapshot()
+
+    cache_txn = _cache_over(srv_txn)  # KBT_TXN_COALESCE default: on
+    assert cache_txn._txn_coalesce and cache_txn.store.supports_txn()
+    monkeypatch.setenv("KBT_TXN_COALESCE", "0")
+    cache_gang = _cache_over(srv_gang)
+    assert not cache_gang._txn_coalesce
+
+    txn0 = metrics.store_backend_txn_batch.snapshot()
+    bind_gangs(cache_txn, GANG_NODES)
+    bind_gangs(cache_gang, GANG_NODES)
+    txn1 = metrics.store_backend_txn_batch.snapshot()
+
+    # one batch carrying all three gangs, and only from the coalescing
+    # cache — the per-gang twin never touched /backend/v1/txn
+    assert txn1["count"] == txn0["count"] + 1
+    assert txn1["sum"] == txn0["sum"] + len(GANG_NODES)
+
+    for g, n in GANG_NODES.items():
+        for m in range(2):
+            p_txn = srv_txn.store.get_pod("default", f"{g}-p{m}")
+            p_gang = srv_gang.store.get_pod("default", f"{g}-p{m}")
+            assert p_txn.node_name == n == p_gang.node_name
+    expected = sorted(f"default/{g}-p{m}" for g in GANG_NODES for m in range(2))
+    for counts in (counts_txn, counts_gang):
+        assert sorted(counts) == expected
+        assert all(c == 1 for c in counts.values()), f"duplicates: {counts}"
+    assert det_txn.violations() == [] and det_gang.violations() == []
+    assert fsck(srv_txn.store) == [] and fsck(srv_gang.store) == []
+
+
+class _Killed(BaseException):
+    """SIGKILL stand-in (BaseException: no retry ladder survives it)."""
+
+
+class _DyingBackend(LoopbackBackend):
+    """Dies exactly at the coalesced submit — after the journal holds
+    every gang's intents, before any write reaches the store."""
+
+    def submit_txn(self, txns):
+        raise _Killed()
+
+
+def test_txn_sigkill_mid_batch_leaves_no_journal_orphans(
+    make_arbiter, tmp_path
+):
+    """Acceptance drill: leader killed mid-coalesced-batch — nothing
+    landed, the journal holds the whole cycle as orphans, and standby
+    reconciliation re-drives every gang exactly once (fsck clean,
+    mutation detector clean, zero orphans on re-replay)."""
+    srv = make_arbiter()
+    seed_store(srv.store, nodes=2, gangs=("ga", "gb"), members=2)
+    counts = count_bind_events(srv.store)
+    journal = WriteIntentJournal(str(tmp_path / "leader.wal"))
+    cache = SchedulerCache(
+        _DyingBackend(_base(srv)), conditional_binds=True, journal=journal
+    )
+    cache.snapshot()
+    assert cache.store.supports_txn()
+    with pytest.raises(_Killed):
+        bind_gangs(cache, {"ga": "n0", "gb": "n1"})
+
+    # died before anything reached the store: all four intents orphaned
+    pods = [f"default/{g}-p{m}" for g in ("ga", "gb") for m in range(2)]
+    for key in pods:
+        ns, name = key.split("/")
+        assert not srv.store.get_pod(ns, name).node_name
+    orphans = WriteIntentJournal.replay(journal.path).orphans
+    assert sorted((i.op, i.pod) for i in orphans) == sorted(
+        ("bind", p) for p in pods
+    )
+
+    # standby takeover: reconcile the WAL against store truth
+    standby = WriteIntentJournal(journal.path)
+    det = MutationDetector(srv.store)
+    det.snapshot()
+    report = reconcile_journal(standby, srv.store)
+    assert report.redispatched == len(pods) and report.rolled_back == 0
+    for g, n in (("ga", "n0"), ("gb", "n1")):
+        for m in range(2):
+            assert srv.store.get_pod("default", f"{g}-p{m}").node_name == n
+    assert sorted(counts) == sorted(pods)
+    assert all(c == 1 for c in counts.values()), f"duplicates: {counts}"
+    assert det.violations() == []
+    assert fsck(srv.store) == []
+    assert WriteIntentJournal.replay(journal.path).orphans == []
+    journal.close()
+    standby.close()
+
+
+@pytest.mark.chaos
+def test_chaos_txn_batch_fault_degrades_loudly_to_per_gang(
+    make_arbiter, monkeypatch
+):
+    """store.txn_batch armed mid-batch: the coalesced path must degrade
+    LOUDLY to per-gang conditional writes — every pod still lands
+    exactly once, no batch is observed, and the degradation is named in
+    the error log."""
+    srv = make_arbiter()
+    seed_store(srv.store, nodes=2, gangs=("ga", "gb"), members=2)
+    counts = count_bind_events(srv.store)
+    cache = _cache_over(srv)
+    assert cache.store.supports_txn()
+
+    errors: list[str] = []
+    real_errorf = log.errorf
+
+    def spying_errorf(fmt, *args):
+        errors.append(fmt % args if args else fmt)
+        real_errorf(fmt, *args)
+
+    monkeypatch.setattr(log, "errorf", spying_errorf)
+    txn0 = metrics.store_backend_txn_batch.snapshot()
+    faults.registry.arm("store.txn_batch", count=1)
+    bind_gangs(cache, {"ga": "n0", "gb": "n1"})
+
+    assert any(
+        "degrading 2 gang(s) to per-gang conditional writes" in e
+        for e in errors
+    ), errors
+    # no batch landed — the cycle went out as per-gang v1 writes
+    assert metrics.store_backend_txn_batch.snapshot()["count"] == txn0["count"]
+    for g, n in (("ga", "n0"), ("gb", "n1")):
+        for m in range(2):
+            assert srv.store.get_pod("default", f"{g}-p{m}").node_name == n
+    expected = sorted(f"default/{g}-p{m}" for g in ("ga", "gb") for m in range(2))
+    assert sorted(counts) == expected
+    assert all(c == 1 for c in counts.values()), f"duplicates: {counts}"
+    assert fsck(srv.store) == []
