@@ -732,6 +732,50 @@ def federation_kill_mttr_row(sessions: int = 5) -> dict:
     }
 
 
+def admission_storm_row(duration_s: float = 8.0) -> dict:
+    """Overload-hardened streaming federation (ISSUE 18): the live
+    federated storm — Poisson tenant lanes offered at ~5x capacity
+    against 2 streaming shards behind the admission front door — run
+    as three cells: admission ON (the protected high lane's tail and
+    zero shed), admission OFF (the measured collapse that motivates
+    the gate), and ON + SIGKILL'd shard (adoption MTTR under sustained
+    overload). Exactly-once, fsck, drain and listener hygiene are
+    asserted per cell by the drill itself; this row flattens the
+    headline numbers into directional bench_diff columns
+    (``storm_high_p99_s``/``storm_mttr_s`` lower-better,
+    ``storm_goodput_pods_per_s`` higher-better, ``storm_shed_*``
+    informational)."""
+    from kube_batch_tpu.admission import storm_row
+
+    r = storm_row(shards=2, duration_s=duration_s)
+    assert r["ok"], f"storm drill failed: {r}"
+    on, off, kill = r["on"], r["off"], r["kill"]
+    return {
+        "duration_s": duration_s,
+        "shards": on["shards"],
+        "storm_goodput_pods_per_s": on["pods_per_s"],
+        "storm_high_p99_s": on["lane_p99_s"].get("high"),
+        "storm_mttr_s": kill["mttr_s"],
+        "storm_shed_high": on["shed"].get("high", 0),
+        "storm_shed_batch": on["shed"].get("batch", 0),
+        "storm_shed_low": on["shed"].get("low", 0),
+        # the collapse the gate prevents, kept for the narrative diff
+        "off_high_p99_s": off["lane_p99_s"].get("high"),
+        "off_bound": off["bound"],
+        "brownout_level_final": on["brownout_level_final"],
+        "journal_orphans": kill["journal_orphans"],
+        "exactly_once": bool(
+            on["exactly_once"] and off["exactly_once"] and kill["exactly_once"]
+        ),
+        "note": (
+            "live federated storm, 3 cells (on/off/kill): per-tenant "
+            "token-bucket lanes + fleet-SLO brownout ladder in front of "
+            "2 streaming shards at ~5x offered load; MTTR cell kills "
+            "one shard mid-storm and measures adoption recovery"
+        ),
+    }
+
+
 def federation_scaleout_row(
     gangs: int = 5000,
     members: int = 10,
@@ -1539,6 +1583,12 @@ def main() -> None:
     # throughput and strictly-leaner-bytes claims asserted at N=4/8.
     # bench_diff expands these into <row>.wire_v<p>_n<N> pseudo-rows.
     details["federation_scaleout_50k"]["wire_runs"] = federation_wire_runs()
+
+    # Admission storm (ISSUE 18): the overload drill as a headline row —
+    # protected-lane p99 + goodput with admission ON, the OFF collapse
+    # for contrast, and kill-cell MTTR; directional columns gated by
+    # bench_diff (_STORM_LOWER/_STORM_HIGHER).
+    details["admission_storm"] = admission_storm_row()
 
     # Headline speedup at the headline config (VERDICT r3 item 2).
     serial_50k = e50k.get("serial_s")

@@ -56,6 +56,13 @@ back to v1 throughput or v1 byte volume is a ``regression`` finding,
 not an ``[info]`` line. Their ``exactly_once``/``union_parity`` bits
 join the parity gate.
 
+Admission-storm columns (ISSUE 18) gate the same way with their own
+directions: ``storm_high_p99_s`` (the protected lane's tail under
+overload) and ``storm_mttr_s`` (kill-cell recovery) regress when they
+grow, ``storm_goodput_pods_per_s`` when it shrinks; ``storm_shed_*``
+counts are ``[info]`` (shed volume is a policy outcome of offered
+load, pinned by the row's own ``ok`` bit rather than diffed).
+
 ``--json`` emits one machine-readable summary line; ``--strict`` exits
 nonzero when any finding fired (default exit is 0 — informational).
 """
@@ -92,18 +99,29 @@ _INFO_KEYS = (
 # path quietly degraded to per-gang writes).
 _WIRE_LOWER = ("wire_bytes_per_bind",)
 _WIRE_HIGHER = ("binds_per_s",)
+# admission-storm columns (ISSUE 18): the protected lane's tail and the
+# kill-cell MTTR regress when they GROW; storm goodput regresses when
+# it SHRINKS. Shed counts are load-dependent policy outcomes (a faster
+# solver sheds less at the same offered rate), so they print as [info]
+# — the protected-lane zero-shed claim is asserted inside the row's
+# own ``ok`` bit, not diffed across rounds.
+_STORM_LOWER = ("storm_high_p99_s", "storm_mttr_s")
+_STORM_HIGHER = ("storm_goodput_pods_per_s",)
 
 
 def _is_info_key(key: str) -> bool:
-    return key in _INFO_KEYS or key.startswith("fleet_")
+    return (key in _INFO_KEYS or key.startswith("fleet_")
+            or key.startswith("storm_shed_"))
 
 
 def _is_wire_lower(key: str) -> bool:
-    return key in _WIRE_LOWER or key.startswith("backend_rtt_")
+    return (key in _WIRE_LOWER or key in _STORM_LOWER
+            or key.startswith("backend_rtt_"))
 
 
 def _is_wire_higher(key: str) -> bool:
-    return key in _WIRE_HIGHER or key.startswith("txn_batch")
+    return (key in _WIRE_HIGHER or key in _STORM_HIGHER
+            or key.startswith("txn_batch"))
 
 
 def _rows_from_obj(obj):

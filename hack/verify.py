@@ -62,7 +62,17 @@ Runs, in order:
     --json): on a seeded cluster with one stuck gang per feasibility
     plane, the batched device forensics must match the serial twin
     byte-for-byte, report each gang's designed dominant reason and
-    would-fit-if planes, and land those reasons on PodGroup conditions.
+    would-fit-if planes, and land those reasons on PodGroup conditions;
+11. the fleet-aggregation smoke (python -m kube_batch_tpu.obs.fleet
+    --json) at 2 and 4 shards: merged fleet percentiles must land
+    within the sketch's declared relative-error bound of the pooled
+    raw samples;
+12. the admission smoke (python -m kube_batch_tpu.admission --json):
+    the deterministic virtual-clock 5x-overload plant — with lanes +
+    the fleet-SLO brownout ladder armed the protected lane must hold
+    its tail SLO with zero shed while the unprotected OFF twin
+    collapses, and every shed decision must carry Retry-After
+    guidance.
 
 With ``--bench-diff OLD NEW``, two bench artifacts (fresh bench.py
 output or archived BENCH_*.json wrappers) are regression-gated via
@@ -77,7 +87,12 @@ With ``--chaos``, two more gates run: the chaos-marked pytest subset
 — fault drills, the crash-consistent failover e2e, the conflict chaos
 drill), and ``kube_batch_tpu.recovery.fsck`` against a seeded journal
 fixture (a known half-confirmed WAL must fsck clean with the expected
-orphan count, and ``--strict`` must gate on it).
+orphan count, and ``--strict`` must gate on it); plus the real-clock
+admission storm drill (``python -m kube_batch_tpu.admission --storm
+--json --duration 4`` — the three-cell ON/OFF/KILL comparison: the
+protected lane's tail held under 5x overload, the OFF twin measurably
+worse, and a mid-storm shard kill recovered with zero journal
+orphans).
 
 With ``--federation``, the federation gate runs: the wire-path smoke
 (``python -m kube_batch_tpu.federation --json`` — N schedulers over one
@@ -88,7 +103,12 @@ leave store truth fsck-clean, and the kill-and-adopt drill
 (``python -m kube_batch_tpu.federation --json --kill-one`` — one of
 four leased shard owners killed mid-``bind_many``; a survivor must
 adopt the orphaned slot within the lease window, reconcile the dead
-owner's journal, and finish every gang exactly once, fsck-clean).
+owner's journal, and finish every gang exactly once, fsck-clean), and
+the streaming-federation smoke (``python -m kube_batch_tpu.federation
+--json --streaming`` — shards on event-driven micro-cycles absorbing
+peer binds as occupancy patches must reach parity with the classic
+federated run, micro-cycles actually taken, exactly-once, fsck-clean,
+pumps and listeners shut down clean).
 
 Exit 0 iff every gate is clean.
 Usage:  python hack/verify.py [--strict] [--chaos] [--federation]
@@ -454,6 +474,27 @@ def run_federation_gate(env: dict) -> dict:
     if res.returncode != 0 or not kill.get("ok", False):
         print(f"verify: federation kill-and-adopt drill FAILED ({kill})")
         ok = False
+    # the streaming-federation smoke (ISSUE 18 tentpole): N shards on
+    # event-driven micro-cycles absorbing peer binds as occupancy
+    # patches — parity with the classic federated run, micro-cycles
+    # actually taken, exactly-once, fsck clean, pumps and listeners
+    # shut down clean
+    env_st = dict(env)
+    env_st.pop("KBT_STREAMING", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.federation", "--json",
+         "--streaming"],
+        cwd=REPO, env=env_st, capture_output=True, text=True,
+    )
+    stream: dict = {}
+    try:
+        stream = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: streaming-federation smoke produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    if res.returncode != 0 or not stream.get("ok", False):
+        print(f"verify: streaming-federation smoke FAILED ({stream})")
+        ok = False
     return {
         "ok": ok,
         "shards": summary.get("shards"),
@@ -464,6 +505,8 @@ def run_federation_gate(env: dict) -> dict:
         "kill_adopter": kill.get("adopter"),
         "kill_takeover_s": kill.get("takeover_s"),
         "kill_mttr_s": kill.get("mttr_s"),
+        "streaming_micro_cycles": stream.get("micro_cycles"),
+        "streaming_parity": stream.get("parity"),
     }
 
 
@@ -986,6 +1029,41 @@ def main(argv: list[str] | None = None) -> int:
     if not gates["fleet_obs_smoke"]["ok"]:
         failed = True
 
+    # 7c-quater. admission smoke: the deterministic 5x-overload plant
+    # (python -m kube_batch_tpu.admission --json) — the protected lane
+    # holds its SLO tail with zero shed while the admission-OFF twin
+    # collapses, the brownout ladder escalates and recovers without
+    # flapping, and every shed carries Retry-After guidance. Part of
+    # the default gate set (virtual clock: sub-second wall time).
+    env_adm = dict(env)
+    for var in ("KBT_ADMISSION", "KBT_ADMISSION_RATE",
+                "KBT_ADMISSION_BURST", "KBT_ADMISSION_BACKLOG",
+                "KBT_ADMISSION_P99_SLO_S", "KBT_ADMISSION_BAND",
+                "KBT_ADMISSION_INTERVAL_S", "KBT_ADMISSION_MIN_RATE",
+                "KBT_FLEET"):
+        env_adm.pop(var, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.admission", "--json"],
+        cwd=REPO, env=env_adm, capture_output=True, text=True,
+    )
+    adm_summary: dict = {}
+    try:
+        adm_summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    adm_on = adm_summary.get("on") or {}
+    adm_ok = res.returncode == 0 and adm_summary.get("ok", False)
+    gates["admission_smoke"] = {
+        "ok": adm_ok,
+        "tail_p99_s": adm_on.get("tail_p99_s"),
+        "high_shed": ((adm_on.get("counts") or {}).get("high") or {}).get("shed"),
+        "level_final": adm_on.get("level_final"),
+    }
+    if not adm_ok:
+        print(res.stdout, res.stderr, sep="\n")
+        print("verify: admission smoke FAILED")
+        failed = True
+
     # 7d. --federation: the wire-path smoke + the seeded two-scheduler
     # conflict drill (optimistic concurrency over the extracted backend)
     if federation:
@@ -998,6 +1076,33 @@ def main(argv: list[str] | None = None) -> int:
         chaos_ok = run_chaos_gate(env)
         gates["chaos"] = {"ok": chaos_ok}
         if not chaos_ok:
+            failed = True
+
+        # 8b. the admission storm drill (real-clock, ~1 min): the
+        # three-cell ON/OFF/KILL comparison — protected-lane tail held
+        # under 5x overload, the OFF twin measurably worse, and
+        # mid-storm shard death recovered with zero orphans
+        env_storm = dict(env_adm)
+        res = subprocess.run(
+            [sys.executable, "-m", "kube_batch_tpu.admission", "--storm",
+             "--json", "--duration", "4"],
+            cwd=REPO, env=env_storm, capture_output=True, text=True,
+        )
+        storm: dict = {}
+        try:
+            storm = json.loads(res.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            print("verify: admission storm drill produced no parseable summary")
+            print(res.stdout, res.stderr, sep="\n")
+        storm_ok = res.returncode == 0 and storm.get("ok", False)
+        gates["admission_storm"] = {
+            "ok": storm_ok,
+            "on_high_p99_s": (storm.get("on") or {}).get(
+                "lane_p99_s", {}).get("high"),
+            "kill_mttr_s": (storm.get("kill") or {}).get("mttr_s"),
+        }
+        if not storm_ok:
+            print(f"verify: admission storm drill FAILED ({storm})")
             failed = True
 
     # 9. --bench-diff OLD NEW: regression-gate two bench artifacts

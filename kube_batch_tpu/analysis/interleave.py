@@ -1141,6 +1141,133 @@ class DispatchVsNextSolve(Scenario):
         super().cleanup()
 
 
+# The admission gate has its own RLock the witness does not wrap; the
+# virtual token serializes gate-touching steps in the commutation check
+# the same way STATE does for the lockless resident table.
+GATE = "admission_gate"
+F_GATE = frozenset({GATE})
+
+
+class AdmissionStorm(Scenario):
+    name = "admission_storm"
+    describe = (
+        "front-door admission (decide + lane charge) racing brownout "
+        "escalation ticks, a closed-lane shed, and the micro/full "
+        "dispatch whose bind echoes credit the lanes back: every "
+        "schedule must bind each admitted pod exactly once, never "
+        "admit through the closed lane, land on the same brownout "
+        "level, and leave zero inflight once all echoes are in"
+    )
+
+    def build(self) -> None:
+        from kube_batch_tpu import admission
+        from kube_batch_tpu.cache.store import PODS, EventHandler
+
+        self._wire(nodes=4)
+        self.sched.run_once()  # adopt the resident table
+        # Two sustained over-SLO ticks (UP_TICKS) escalate the ladder
+        # regardless of where they land in the schedule; the high lane
+        # is brownout-protected and the low lane is rate-closed, so
+        # every decide outcome is schedule-independent by construction.
+        hot = {
+            "enabled": True,
+            "slo": {"time_to_bind": {"high": {"n": 50, "p99": 5.0}}},
+            "backlog_pods": 0.0,
+            "shard_up": {"http://s0": True},
+            "node_conflict_topk": {},
+        }
+        self.gate = admission.AdmissionGate(
+            [admission.LaneSpec("high", 100, rate=50.0, burst=50.0, backlog=120),
+             admission.LaneSpec("low", 0, rate=1e-4, burst=1.0, backlog=120)],
+            fleet_fn=lambda: hot, age_fn=lambda: 0.0,
+            slo_s=1.0, interval_s=1000.0,
+        )
+        # the storm pre-state: the low lane burned its burst before this
+        # window opens, and at 1e-4 tokens/s it cannot accrue a whole
+        # token during the run — every schedule sheds it identically
+        # (shed_rate before a tick lands, shed_brownout after)
+        self.gate.lanes["low"].bucket._tokens = 0.0
+        self.shed_decisions: list = []
+
+        def on_update(old, new):
+            # the server's wiring: a bind echo credits the lane backlog
+            if not old.node_name and new.node_name:
+                self.gate.note_done(f"{new.namespace}/{new.name}")
+
+        self.store.add_event_handler(PODS, EventHandler(on_update=on_update))
+
+        def admit_and_arrive():
+            for m in range(2):
+                d = self.gate.decide("high", f"default/g1-p{m}")
+                if not d.admitted:
+                    raise AssertionError(
+                        f"protected high lane shed an arrival: {d.reason}"
+                    )
+            self._arrive(self.store, "g1", 2)
+
+        def force_tick():
+            # the step IS the tick: rewind the interval clock so
+            # maybe_tick fires exactly here and nowhere else (decide's
+            # own maybe_tick stays blocked by the 1000s interval)
+            self.gate._last_tick = -1e9
+            self.gate.maybe_tick()
+
+        def shed_low():
+            self.shed_decisions.append(self.gate.decide("low", "default/shed-0"))
+
+        self.threads = [
+            [
+                Step("admit_arrive_high", admit_and_arrive, F_EVENT | F_GATE),
+                Step("micro_drain",
+                     lambda: self.sched.run_micro(self.trigger.drain()),
+                     F_ALL | F_GATE),
+            ],
+            [
+                Step("pressure_tick_1", force_tick, F_GATE),
+                Step("pressure_tick_2", force_tick, F_GATE),
+            ],
+            [
+                Step("shed_low", shed_low, F_GATE),
+                Step("full_backstop", self.sched.run_once, F_ALL | F_GATE),
+            ],
+        ]
+
+    def fingerprint(self):
+        # placements + the settled controller level: schedules must
+        # agree on both (two hot ticks always escalate exactly once)
+        return (tuple(sorted(self.placements().items())),
+                self.gate.controller.level)
+
+    def invariants(self) -> list:
+        out = super().invariants()
+        lanes = self.gate.lanes
+        if lanes["low"].admitted != 0:
+            out.append(
+                f"closed low lane admitted {lanes['low'].admitted} pods"
+            )
+        if lanes["high"].admitted != 2:
+            out.append(
+                f"high lane admitted {lanes['high'].admitted} pods, want 2"
+            )
+        for d in self.shed_decisions:
+            if d.admitted or not d.reason.startswith("shed_"):
+                out.append(f"closed-lane decide leaked through: {d}")
+            if d.retry_after_s <= 0:
+                out.append(f"shed without Retry-After guidance: {d}")
+        inflight = sum(l.inflight for l in lanes.values())
+        if inflight != 0:
+            out.append(
+                f"{inflight} admitted pods never credited back — a bind "
+                "echo was lost or double-charged"
+            )
+        if self.gate.controller.level < 1:
+            out.append(
+                "two sustained over-SLO ticks never escalated the "
+                "brownout ladder — the overload response is inert"
+            )
+        return out
+
+
 SCENARIOS = {
     c.name: c
     for c in (
@@ -1151,6 +1278,7 @@ SCENARIOS = {
         TwoSchedulerConflict,
         DispatchVsNextSolve,
         AdoptVsDispatch,
+        AdmissionStorm,
     )
 }
 FIXTURES = {BrokenDrain.name: BrokenDrain}

@@ -34,16 +34,22 @@ class NodeInfo:
             self.capability = Resource.from_resource_list(node.capacity)
 
     def clone(self) -> "NodeInfo":
-        """reference node_info.go:77-86."""
+        """reference node_info.go:77-86.
+
+        Resident tasks are committed facts; replay them with overcommit
+        tolerance so cloning (the per-cycle snapshot) of a node two
+        shards raced binds onto reproduces the negative idle instead of
+        aborting the whole scheduling cycle."""
         res = NodeInfo(self.node)
         for task in self.tasks.values():
-            res.add_task(task)
+            res.add_task(task, overcommit=True)
         res.other = self.other
         return res
 
     def set_node(self, node: Node) -> None:
         """Reset accounting from a fresh node object, replaying resident
-        tasks (reference node_info.go:89-105)."""
+        tasks (reference node_info.go:89-105). Overcommit-tolerant for
+        the same reason as clone(): the replay records facts."""
         self.name = node.name
         self.node = node
         self.allocatable = Resource.from_resource_list(node.allocatable)
@@ -54,14 +60,21 @@ class NodeInfo:
         for task in self.tasks.values():
             if task.status == TaskStatus.RELEASING:
                 self.releasing.add(task.resreq)
-            self.idle.sub(task.resreq)
+            self.idle.sub_overcommit(task.resreq)
             self.used.add(task.resreq)
 
-    def add_task(self, task: TaskInfo) -> None:
+    def add_task(self, task: TaskInfo, overcommit: bool = False) -> None:
         """Status-dependent accounting (reference node_info.go:108-136):
         Releasing consumes Idle but is also tracked as Releasing; Pipelined
         rides on resources still being released (subtracts Releasing, not
-        Idle); everything else consumes Idle. Used grows in all cases."""
+        Idle); everything else consumes Idle. Used grows in all cases.
+
+        ``overcommit=True`` records the task even when idle cannot cover
+        it (idle goes negative). The cache's watch-event path uses this:
+        a bound pod delivered by the store is a committed fact — two
+        federated shards racing binds onto one node must not kill the
+        pump with an accounting assertion. Allocation paths keep the
+        strict raise."""
         key = pod_key(task.pod)
         if key in self.tasks:
             raise KeyError(
@@ -69,13 +82,14 @@ class NodeInfo:
             )
         ti = task.clone()
         if self.node is not None:
+            sub = Resource.sub_overcommit if overcommit else Resource.sub
             if ti.status == TaskStatus.RELEASING:
                 self.releasing.add(ti.resreq)
-                self.idle.sub(ti.resreq)
+                sub(self.idle, ti.resreq)
             elif ti.status == TaskStatus.PIPELINED:
-                self.releasing.sub(ti.resreq)
+                sub(self.releasing, ti.resreq)
             else:
-                self.idle.sub(ti.resreq)
+                sub(self.idle, ti.resreq)
             self.used.add(ti.resreq)
         self.tasks[key] = ti
 

@@ -203,6 +203,21 @@ class Resource:
                 self.scalars[name] = self.scalars.get(name, 0.0) - q
         return self
 
+    def sub_overcommit(self, rr: "Resource") -> "Resource":
+        """Subtract WITHOUT the fitness assertion — fields may go
+        negative. For recording facts the store already committed (a
+        bound pod arriving over the watch): two federated shards can
+        race binds onto one node, and the mirror must reflect the
+        overcommit rather than reject it. Negative idle reads as unfit
+        to every less_equal admission check, so the local allocator
+        naturally backs off the oversubscribed node."""
+        self.milli_cpu -= rr.milli_cpu
+        self.memory -= rr.memory
+        if self.scalars:
+            for name, q in rr.scalars.items():
+                self.scalars[name] = self.scalars.get(name, 0.0) - q
+        return self
+
     def set_max_resource(self, rr: "Resource") -> None:
         """Elementwise max, in place (reference resource_info.go:169-196)."""
         if rr is None:

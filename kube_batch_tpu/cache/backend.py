@@ -465,6 +465,14 @@ class LoopbackBackend:
         MODIFIED events arrive as field-level deltas; under v1 it is the
         original per-kind cursor poll. A partition skips the round
         (mirror stales, snapshot_age grows) instead of raising."""
+        if faults.should_fire("stream.pump"):
+            # injected pump drop (streaming-federation drills): the round
+            # is skipped whole — no partial event batch — so the mirror
+            # simply ages and the staleness guard / backstop full cycle
+            # own the degradation, exactly as for a real partition
+            log.V(3).infof("stream.pump: injected watch-pump drop")
+            self._stop.wait(0.02)  # keep an armed drill from spinning hot
+            return 0
         with self._lock:
             use_v2 = (
                 self._protocol is not None
@@ -622,12 +630,24 @@ class LoopbackBackend:
     def _dispatch(handlers: list[EventHandler], batch: list[tuple]) -> int:
         for verb, old, new in batch:
             for h in handlers:
-                if verb == "add":
-                    h.add(new)
-                elif verb == "update":
-                    h.update(old, new)
-                else:
-                    h.delete(old)
+                # A handler raising must not kill the pump thread: the
+                # pump is shared infrastructure, and one bad object
+                # stalling EVERY kind's watch silently is the worst
+                # failure mode a shard has. Log and keep pumping — the
+                # mirror itself is already updated, so a later relist or
+                # event for the same key re-converges the handler state.
+                try:
+                    if verb == "add":
+                        h.add(new)
+                    elif verb == "update":
+                        h.update(old, new)
+                    else:
+                        h.delete(old)
+                except Exception as e:  # noqa: BLE001 — pump survival
+                    log.errorf(
+                        "watch handler %s failed (%s): %s", verb,
+                        type(e).__name__, e,
+                    )
         return len(batch)
 
     def start(self, period: float = 0.2) -> None:

@@ -693,7 +693,12 @@ class SchedulerCache:
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo(None)
             if not _is_terminated(ti.status):
-                self.nodes[ti.node_name].add_task(ti)
+                # overcommit=True: this is the watch-event path — the
+                # store already committed the bind. A cross-shard bind
+                # race can oversubscribe a node; the mirror records the
+                # negative idle (node reads unfit) instead of raising
+                # out of the pump thread.
+                self.nodes[ti.node_name].add_task(ti, overcommit=True)
 
     @assume_locked
     def _add_pod(self, pod: Pod) -> None:
@@ -1036,7 +1041,12 @@ class SchedulerCache:
                 raise KeyError(f"failed to bind task {task.uid}: host {hostname} missing")
             job.update_task_status(task, TaskStatus.BINDING)
             task.node_name = hostname
-            node.add_task(task)
+            # overcommit=True: the session solved over a snapshot; the
+            # live node may have drifted (a peer shard's bind landed
+            # meanwhile). The store's conditional write is the real
+            # admission check — raising here would strand the task in
+            # Binding with no write submitted and no resync.
+            node.add_task(task, overcommit=True)
             pod = task.pod
         seqs = self._journal_intents(
             "bind", [(task.job, f"{pod.namespace}/{pod.name}", hostname)]
@@ -1073,7 +1083,9 @@ class SchedulerCache:
                         continue
                     job.update_task_status(task, TaskStatus.BINDING)
                     task.node_name = hostname
-                    node.add_task(task)
+                    # overcommit=True: same as bind() — snapshot drift
+                    # from a peer shard's bind must not strand the task
+                    node.add_task(task, overcommit=True)
                     resolved.append((task.pod, hostname, task))
             for ti in failed:
                 self.resync_task(ti)

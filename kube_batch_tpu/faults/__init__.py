@@ -112,6 +112,11 @@ POINTS = (
     "native.prepass",   # bulk_assign prepass raises -> Python replay
     "native.dispatch",  # bulk_dispatch raises -> Python dispatch barrier
     "native.class_dedup",  # class_dedup unavailable -> np.unique fallback
+    # streaming federation watch pump (cache/backend.py pump)
+    "stream.pump",      # pump round dropped -> mirror ages, backstop full cycle
+    # admission control plane (admission.py, server.py front door)
+    "admission.shed",   # gate sheds an admit that would have passed -> 429
+    "admission.controller",  # controller tick dies -> fail-static last outputs
 )
 
 

@@ -537,6 +537,42 @@ fleet_shard_scrape_age = Gauge(
     "shard, -1 = never scraped)",
 )
 
+# -- admission control plane (kube_batch_tpu.admission, KBT_ADMISSION) -------
+# Per-tenant lanes at the workload-API front door plus the backpressure
+# controller that retunes them from measured fleet state. Decisions are
+# counted, never silently dropped: every shed is visible here and carried
+# a 429 + Retry-After on the wire.
+admission_decisions = Counter(
+    f"{_SUBSYSTEM}_admission_decisions_total",
+    "Front-door admission decisions, by lane and outcome "
+    "(admitted/shed_rate/shed_backlog/shed_brownout/shed_fault)",
+)
+admission_lane_backlog = Gauge(
+    f"{_SUBSYSTEM}_admission_lane_backlog_pods",
+    "Admitted-but-unbound pods the gate currently charges to each lane "
+    "(labels: lane) — the bounded backlog that 429s when full",
+)
+admission_lane_rate = Gauge(
+    f"{_SUBSYSTEM}_admission_lane_admit_rate",
+    "Token-bucket refill rate in pods/s the controller currently grants "
+    "each lane (labels: lane)",
+)
+admission_brownout_level = Gauge(
+    f"{_SUBSYSTEM}_admission_brownout_level",
+    "Current rung on the brownout ladder (0 = all lanes at configured "
+    "rate; higher rungs defer lower-priority tiers first)",
+)
+admission_pressure = Gauge(
+    f"{_SUBSYSTEM}_admission_pressure",
+    "Composite overload signal the backpressure controller computed from "
+    "merged fleet state (1.0 = at the configured SLO band ceiling)",
+)
+admission_controller_ticks = Counter(
+    f"{_SUBSYSTEM}_admission_controller_ticks_total",
+    "Backpressure controller evaluations, by outcome "
+    "(steady/escalate/recover/fault/dark)",
+)
+
 # -- device-phase telemetry (arena HBM accounting, ops/encode_cache) ---------
 arena_hbm_bytes = Gauge(
     f"{_SUBSYSTEM}_arena_hbm_bytes",
@@ -779,6 +815,30 @@ def set_fleet_shard_scrape_age(shard: str, age_s: float) -> None:
     fleet_shard_scrape_age.set(age_s, {"shard": shard})
 
 
+def register_admission_decision(lane: str, outcome: str) -> None:
+    admission_decisions.inc({"lane": lane, "outcome": outcome})
+
+
+def set_admission_lane_backlog(lane: str, n: int) -> None:
+    admission_lane_backlog.set(n, {"lane": lane})
+
+
+def set_admission_lane_rate(lane: str, rate: float) -> None:
+    admission_lane_rate.set(rate, {"lane": lane})
+
+
+def set_admission_brownout_level(level: int) -> None:
+    admission_brownout_level.set(level)
+
+
+def set_admission_pressure(value: float) -> None:
+    admission_pressure.set(value)
+
+
+def register_admission_controller_tick(outcome: str) -> None:
+    admission_controller_ticks.inc({"outcome": outcome})
+
+
 def set_arena_hbm_bytes(slab: str, nbytes: float) -> None:
     arena_hbm_bytes.set(nbytes, {"slab": slab})
 
@@ -935,6 +995,12 @@ def render_prometheus_text() -> str:
         fleet_shards_scraped,
         fleet_shard_up,
         fleet_shard_scrape_age,
+        admission_decisions,
+        admission_lane_backlog,
+        admission_lane_rate,
+        admission_brownout_level,
+        admission_pressure,
+        admission_controller_ticks,
         arena_hbm_bytes,
         arena_hbm_watermark,
     ]

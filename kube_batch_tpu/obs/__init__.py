@@ -122,7 +122,13 @@ SPAN_NAMES = (
 # Every /debug/* route server.py serves. Checked both directions by the
 # KBT-R analyzer (R009/R010/R012) against server.py literals and the
 # runbook endpoint table.
-DEBUG_ENDPOINTS = ("/debug/trace", "/debug/slo", "/debug/explain", "/debug/fleet")
+DEBUG_ENDPOINTS = (
+    "/debug/trace",
+    "/debug/slo",
+    "/debug/explain",
+    "/debug/fleet",
+    "/debug/admission",
+)
 
 # Wall/perf anchor pair: spans are stamped with the monotonic clock (so
 # durations survive NTP steps) and exported in wall-clock microseconds

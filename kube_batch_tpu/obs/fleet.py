@@ -65,9 +65,31 @@ __all__ = [
 ]
 
 ENV = "KBT_FLEET"
+TIMEOUT_ENV = "KBT_FLEET_SCRAPE_TIMEOUT_S"
+STALE_ENV = "KBT_FLEET_STALE_S"
 
 _enabled = False
 _peers: tuple[str, ...] = ()
+
+
+def scrape_timeout_s() -> float:
+    """Per-peer scrape timeout. Scrapes run concurrently, so one hung
+    peer delays a refresh by at most this bound — not N x this bound."""
+    try:
+        return max(0.05, float(os.environ.get(TIMEOUT_ENV, "") or 3.0))
+    except ValueError:
+        return 3.0
+
+
+def stale_cap_s() -> float:
+    """Age cap on reusing a dark peer's last good payload in the merge.
+    Within the cap a transient scrape miss does not yank that shard's
+    samples out of the merged gauges; past it the shard's contribution
+    ages out entirely (the conservative read for a dead shard)."""
+    try:
+        return max(0.0, float(os.environ.get(STALE_ENV, "") or 30.0))
+    except ValueError:
+        return 30.0
 
 # The shared disabled result: refresh() returns this singleton when
 # KBT_FLEET is off — identity-testable, same contract as obs.NOOP_SPAN.
@@ -167,10 +189,13 @@ class FleetAggregator:
         self._prev_binds: float | None = None
         self._prev_binds_mono = 0.0
         self._last_seen: dict[str, float] = {}  # peer url -> last good scrape
+        self._payload_cache: dict[str, tuple[float, dict]] = {}
         self.last: dict = {}
 
-    def scrape(self, base_url: str, timeout: float = 3.0) -> dict | None:
+    def scrape(self, base_url: str, timeout: float | None = None) -> dict | None:
         url = base_url.rstrip("/") + "/debug/slo?raw=1"
+        if timeout is None:
+            timeout = scrape_timeout_s()
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
                 return json.loads(resp.read().decode("utf-8"))
@@ -185,6 +210,7 @@ class FleetAggregator:
             self._prev_binds = None
             self._prev_binds_mono = 0.0
             self._last_seen = {}
+            self._payload_cache = {}
             self.last = {}
         metrics.fleet_shard_up.clear()
         metrics.fleet_shard_scrape_age.clear()
@@ -198,13 +224,42 @@ class FleetAggregator:
                 return self.last
             self._last_mono = now
         peer_list = _peers
+        # Scrape OUTSIDE the lock (blocking I/O) and CONCURRENTLY: one
+        # hung peer bounds the refresh by the per-peer timeout, not by
+        # peers x timeout — the publish loop and the admission
+        # controller's input snapshot must not stall on a dark shard.
+        timeout = scrape_timeout_s()
+        results: dict[str, dict | None] = {}
+        workers = [
+            threading.Thread(
+                target=lambda p=peer: results.__setitem__(p, self.scrape(p, timeout)),
+                name="kb-fleet-scrape", daemon=True,
+            )
+            for peer in peer_list
+        ]
+        for worker in workers:
+            worker.start()
+        deadline = time.monotonic() + timeout + 1.0
+        for worker in workers:
+            worker.join(timeout=max(0.0, deadline - time.monotonic()))
         reached: list[str] = []
         payloads: list[dict] = []
-        for peer in peer_list:  # scrape OUTSIDE the lock (blocking I/O)
-            data = self.scrape(peer)
-            if data is not None:
-                reached.append(peer)
-                payloads.append(data)
+        now = time.monotonic()
+        cap = stale_cap_s()
+        with self._lock:
+            for peer in peer_list:
+                data = results.get(peer)
+                if data is not None:
+                    reached.append(peer)
+                    payloads.append(data)
+                    self._payload_cache[peer] = (now, data)
+                    continue
+                cached = self._payload_cache.get(peer)
+                if cached is not None and now - cached[0] <= cap:
+                    # transient miss: keep the last good payload in the
+                    # merge (reachability gauges still flip to dark) so
+                    # merged quantiles don't lurch on one missed scrape
+                    payloads.append(cached[1])
         return self._merge(peer_list, reached, payloads)
 
     def _merge(self, peer_list, reached, payloads) -> dict:

@@ -197,7 +197,14 @@ class Scheduler:
         full cycle — arrivals are never dropped, only served slower."""
         from kube_batch_tpu import streaming
 
-        trigger = streaming.StreamTrigger()
+        # Federated cache (duck-typed by its slot-ownership surface):
+        # peer shards' binds cross the pod filter as bound-pod
+        # adds/deletes — absorb them as occupancy patches instead of
+        # degrading to a full cycle per peer bind. Safe because a
+        # federated cache forces conditional binds: if the absorbed view
+        # ever lags, the store rejects and the retry ladder resyncs.
+        absorb = hasattr(self.cache, "set_owned_slots")
+        trigger = streaming.StreamTrigger(absorb_external=absorb)
         state = streaming.StreamState()
         self._stream_trigger = trigger
         self._stream_state = state
@@ -313,6 +320,15 @@ class Scheduler:
                 self.cache.cycle += 1
                 mspan.set_attr("cycle", self.cache.cycle)
             st.apply_node_patches(work.node_patches)
+            if work.bound_patches and not st.apply_bound_patches(work.bound_patches):
+                # peer-shard occupancy churn the resident table could not
+                # absorb: degrade to the backstop full cycle, backlog kept
+                metrics.register_micro_cycle("stale")
+                log.infof(
+                    "resident table could not absorb bound-pod churn (%s); "
+                    "degrading to a full cycle", st.reason,
+                )
+                return False
             cloned, missing = self.cache.clone_jobs_for_stream(work.gangs)
             # A gang is solvable only once enough of it exists: the podgroup
             # add event lands before its member pods, and a mid-burst drain

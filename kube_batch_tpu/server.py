@@ -624,13 +624,17 @@ def _make_handler(server: "SchedulerServer"):
         def log_message(self, fmt, *args):  # route http.server chatter to V(4)
             log.V(4).infof("http: " + fmt, *args)
 
-        def _reply(self, code: int, body: str, ctype: str = "application/json") -> None:
-            self._reply_bytes(code, body.encode(), ctype)
+        def _reply(self, code: int, body: str, ctype: str = "application/json",
+                   headers: Optional[dict] = None) -> None:
+            self._reply_bytes(code, body.encode(), ctype, headers)
 
-        def _reply_bytes(self, code: int, data: bytes, ctype: str) -> None:
+        def _reply_bytes(self, code: int, data: bytes, ctype: str,
+                         headers: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(data)
 
@@ -674,6 +678,9 @@ def _make_handler(server: "SchedulerServer"):
                 from kube_batch_tpu.obs import fleet as obs_fleet
 
                 obs_fleet.refresh()
+                from kube_batch_tpu import admission
+
+                admission.publish()
                 self._reply(
                     200, metrics.render_prometheus_text(), "text/plain; version=0.0.4"
                 )
@@ -721,6 +728,14 @@ def _make_handler(server: "SchedulerServer"):
                 query = urllib.parse.parse_qs(parsed.query)
                 gang = query.get("gang", [""])[0] or None
                 self._reply(200, json.dumps(obs_explain.debug_payload(gang)))
+            elif path == "/debug/admission":
+                # Admission control plane (admission.py): per-lane
+                # buckets/backlogs/shed counters plus the backpressure
+                # controller's level/pressure. {"enabled": false} when
+                # KBT_ADMISSION is off.
+                from kube_batch_tpu import admission
+
+                self._reply(200, json.dumps(admission.debug_payload()))
             elif path == "/backend/v1/version":
                 # Store-backend protocol (cache/backend.py): the store
                 # version optimistic writes are checked against. A v2
@@ -1164,10 +1179,60 @@ def _make_handler(server: "SchedulerServer"):
                             )
                         if pc is not None:
                             pod.priority = pc.value
-                    server.store.create_pod(pod)
-                    self._reply(
-                        201, json.dumps({"namespace": pod.namespace, "name": pod.name})
-                    )
+                    # Per-tenant admission (admission.py): resolve the
+                    # pod's queue (explicit field, else its podgroup's,
+                    # else the default), ask the lane gate, and refuse
+                    # overload loudly — 429 + Retry-After, never a
+                    # silent drop or an unbounded queue.
+                    from kube_batch_tpu import admission
+
+                    decision = None
+                    pod_key = f"{pod.namespace}/{pod.name}"
+                    if admission.enabled():
+                        from kube_batch_tpu.apis.types import (
+                            GROUP_NAME_ANNOTATION_KEY,
+                        )
+
+                        queue = field(body, "queue", str, "")
+                        group = pod.metadata.annotations.get(
+                            GROUP_NAME_ANNOTATION_KEY, ""
+                        )
+                        if not queue and group:
+                            pg = server.store.get(
+                                "podgroups", f"{pod.namespace}/{group}"
+                            )
+                            if pg is not None:
+                                queue = pg.spec.queue
+                        decision = admission.decide(
+                            queue or server.cache.default_queue, pod_key
+                        )
+                    if decision is not None and not decision.admitted:
+                        self._reply(
+                            429,
+                            json.dumps({
+                                "error": "admission shed",
+                                "lane": decision.lane,
+                                "reason": decision.reason,
+                                "retry_after_s": round(decision.retry_after_s, 3),
+                            }),
+                            headers={
+                                "Retry-After": str(
+                                    max(1, int(decision.retry_after_s + 0.999))
+                                )
+                            },
+                        )
+                    else:
+                        try:
+                            server.store.create_pod(pod)
+                        except Exception:
+                            admission.release(pod_key)
+                            raise
+                        self._reply(
+                            201,
+                            json.dumps(
+                                {"namespace": pod.namespace, "name": pod.name}
+                            ),
+                        )
                 elif self.path == "/apis/v1alpha1/nodes":
                     name = field(body, "name", str, None, required=True)
                     node = build_node(
@@ -1523,6 +1588,25 @@ class SchedulerServer:
                 Queue(metadata=ObjectMeta(name=self.cache.default_queue))
             )
         self.reconcile()
+        # Arm the workload-API admission gate (KBT_ADMISSION) and keep
+        # its backlog accounting truthful: an admitted pod stops
+        # counting against its lane when it binds, or when it is
+        # deleted while still pending (client gave up / reaper).
+        from kube_batch_tpu import admission
+
+        if admission.configure() and self.backend is None:
+            self.store.add_event_handler(
+                "pods",
+                EventHandler(
+                    on_update=lambda old, new: (
+                        admission.note_done(f"{new.namespace}/{new.name}")
+                        if (not old.node_name and new.node_name) else None
+                    ),
+                    on_delete=lambda obj: admission.note_done(
+                        f"{obj.namespace}/{obj.name}"
+                    ),
+                ),
+            )
         if self.backend is not None:
             self.backend.start()
         if self.slot_manager is not None:

@@ -101,12 +101,15 @@ def gang_key_of(pod) -> str:
 class StreamWork:
     """One drained batch of churn: the dirty gang backlog (a *copy* —
     the trigger keeps gangs until they bind), pending node patches
-    (latest object wins, None = deleted), and whether churn arrived that
-    the resident table cannot absorb (bound-pod add/delete from outside
-    our own dispatch path)."""
+    (latest object wins, None = deleted), bound-pod occupancy patches
+    (federated absorb mode: peer binds/releases arriving through the
+    shard filter as adds/deletes), and whether churn arrived that the
+    resident table cannot absorb (bound-pod add/delete from outside our
+    own dispatch path, when absorb mode is off)."""
 
     gangs: set[str] = field(default_factory=set)
     node_patches: dict[str, Optional[object]] = field(default_factory=dict)
+    bound_patches: list = field(default_factory=list)
     stale: bool = False
     stale_reason: str = ""
 
@@ -130,7 +133,16 @@ class StreamTrigger:
       micro-cycles against an unchanged world (the unschedulable
       condition write after every failed solve would self-trigger);
     - bound-pod add or delete: capacity changed outside any session —
-      the resident table is stale, force a full cycle;
+      the resident table is stale, force a full cycle. In **absorb
+      mode** (``absorb_external=True``, federated streaming) these are
+      instead recorded as bound-pod occupancy patches: a peer shard's
+      bind crosses the federated pod filter as an *add* of a bound pod
+      (the pending pod was a peer's, filtered out; client-go filtering
+      semantics turn the transition into an add) and a peer's release
+      as a *delete* — both are plain occupancy changes the resident
+      ``NodeInfo`` table absorbs via add_task/remove_task, and the
+      store's conditional binds remain the correctness backstop if the
+      absorbed view ever lags;
     - node events: recorded as patches the next micro-cycle applies to
       the resident table; wake (new capacity can admit the backlog);
     - podgroup add or spec change: dirty the gang (min_member/queue
@@ -140,10 +152,16 @@ class StreamTrigger:
       events: wake for re-admission.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, absorb_external: bool = False) -> None:
+        # Federated streaming: peer shards' binds arrive as bound-pod
+        # adds/deletes — absorb them as occupancy patches instead of
+        # degrading to a full cycle per peer bind (which would serialize
+        # every shard on everyone else's dispatch rate).
+        self.absorb_external = bool(absorb_external)
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._gangs: set[str] = set()  #: guarded_by _lock
+        self._bound_patches: list = []  #: guarded_by _lock
         self._node_patches: dict[str, Optional[object]] = {}  #: guarded_by _lock
         self._arrivals: dict[str, float] = {}  #: guarded_by _lock  (pod uid -> arrival stamp)
         self._queues: dict[str, str] = {}  #: guarded_by _lock  (gang key -> queue name)
@@ -191,10 +209,12 @@ class StreamTrigger:
             work = StreamWork(
                 gangs=set(self._gangs),
                 node_patches=self._node_patches,
+                bound_patches=self._bound_patches,
                 stale=self._stale,
                 stale_reason=self._stale_reason,
             )
             self._node_patches = {}
+            self._bound_patches = []
             self._stale = False
             self._stale_reason = ""
         return work
@@ -264,6 +284,14 @@ class StreamTrigger:
         now = time.perf_counter()
         if obj is not None and old is None:  # add
             if obj.node_name:
+                if self.absorb_external:
+                    # a peer shard's bind crossing the federated filter:
+                    # occupancy the next micro-cycle charges to the
+                    # resident table — no wake (consumed capacity admits
+                    # nothing new)
+                    with self._lock:
+                        self._bound_patches.append(("add", key, obj))
+                    return
                 self._mark_stale(f"bound pod {key} appeared outside a cycle")
                 return
             with self._lock:
@@ -305,6 +333,13 @@ class StreamTrigger:
                 self._event.set()
         else:  # delete
             if old is not None and old.node_name:
+                if self.absorb_external:
+                    # a peer's release (or a finished pod leaving the
+                    # store): freed capacity can admit the backlog — wake
+                    with self._lock:
+                        self._bound_patches.append(("remove", key, old))
+                    self._event.set()
+                    return
                 self._mark_stale(f"bound pod {key} deleted outside a cycle")
                 return
             with self._lock:
@@ -350,6 +385,37 @@ class StreamState:
                 self.nodes[name] = NodeInfo(node)
             else:
                 ni.set_node(node)
+
+    def apply_bound_patches(self, patches) -> bool:
+        """Absorb peer-shard occupancy churn (federated streaming) into
+        the resident table. Duplicates are benign no-ops — a patch
+        recorded just before a backstop full cycle is already reflected
+        in the adopted snapshot, and ``add_task``/``remove_task`` key by
+        pod, so re-applying it raises KeyError and is skipped. Anything
+        else (unknown node, resource underflow) means the resident view
+        genuinely diverged: invalidate and let the full cycle rebuild.
+        Returns False when invalidated."""
+        from kube_batch_tpu.api.job_info import TaskInfo
+
+        for op, key, pod in patches:
+            try:
+                ni = self.nodes.get(pod.node_name)
+                if ni is None:
+                    raise ValueError(f"node {pod.node_name!r} not resident")
+                if op == "add":
+                    ni.add_task(TaskInfo(pod))
+                else:
+                    ni.remove_task(TaskInfo(pod))
+            except KeyError:
+                # already absorbed (add) / already gone (remove): the
+                # adopted snapshot beat the patch to it
+                continue
+            except Exception as e:  # noqa: BLE001 - degrade, never guess
+                self.invalidate(
+                    f"bound-pod churn not absorbable for {key}: {e}"
+                )
+                return False
+        return True
 
 
 def open_micro_session(cache, tiers, action_arguments, jobs, nodes, queues):

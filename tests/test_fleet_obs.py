@@ -335,6 +335,57 @@ def test_fleet_aggregator_counts_dark_shards():
     assert payload["slo"] == {}
 
 
+def test_fleet_refresh_bounded_by_hung_peer_not_stalled(monkeypatch):
+    """A peer whose socket accepts but never answers (half-dead kernel,
+    wedged shard) must cost one scrape timeout, not hang the refresh:
+    the live shard's data still merges, the hung peer reads shard_up
+    False, and the whole refresh returns within timeout + join slack.
+    The admission BackpressureController reads this payload on its
+    control loop — an unbounded refresh would freeze overload response
+    exactly when a shard is sickest."""
+    import socket
+
+    # kernel completes the TCP handshake for a listening socket even
+    # without accept(): urlopen connects fine, then waits forever for
+    # the response — the exact half-dead shape a crashed-but-not-reaped
+    # shard presents
+    hung = socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(1)
+
+    acct = SLOAccountant(window_s=300.0)
+    acct.observe("time_to_bind", "tenant0", 0.25)
+    srv, th = fleet._serve_observatory(
+        acct, lambda: {"federation_conflicts": {}, "node_conflicts": {},
+                       "streaming_backlog": 0, "binds_total": 1},
+    )
+    live_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    hung_url = f"http://127.0.0.1:{hung.getsockname()[1]}"
+    monkeypatch.setenv(fleet.ENV, f"{live_url},{hung_url}")
+    monkeypatch.setenv(fleet.TIMEOUT_ENV, "0.2")
+    try:
+        fleet.configure()
+        agg = fleet.FleetAggregator()
+        t0 = time.monotonic()
+        payload = agg.refresh(force=True)
+        elapsed = time.monotonic() - t0
+    finally:
+        monkeypatch.delenv(fleet.ENV, raising=False)
+        monkeypatch.delenv(fleet.TIMEOUT_ENV, raising=False)
+        fleet.configure()
+        srv.shutdown()
+        srv.server_close()
+        th.join(timeout=5.0)
+        hung.close()
+    # bound: per-peer timeout (scrapes run concurrently) + 1s join slack
+    assert elapsed < 2.5, f"refresh stalled {elapsed:.2f}s behind a hung peer"
+    assert payload["shards_scraped"] == 1
+    assert payload["shard_up"][live_url] is True
+    assert payload["shard_up"][hung_url] is False
+    assert payload["slo"]["time_to_bind"]["tenant0"]["n"] == 1
+    assert metrics.fleet_shard_up.value({"shard": hung_url}) == 0.0
+
+
 # -- OpenMetrics exemplars ---------------------------------------------------
 
 
@@ -562,6 +613,51 @@ def test_bench_diff_wire_parity_bits_and_improvements():
         f["kind"] == "parity" and "exactly_once" in f["msg"]
         for f in summary["findings"]
     )
+
+
+# -- bench_diff: admission-storm columns gate directionally (ISSUE 18) -------
+
+
+def _storm_row(p99, mttr, goodput, shed_low=20, exactly_once=True):
+    return {"admission_storm": {
+        "storm_high_p99_s": p99, "storm_mttr_s": mttr,
+        "storm_goodput_pods_per_s": goodput, "storm_shed_high": 0,
+        "storm_shed_low": shed_low, "exactly_once": exactly_once,
+    }}
+
+
+def test_bench_diff_storm_columns_gate_with_direction():
+    bd = _bench_diff_mod()
+    old = _storm_row(p99=0.9, mttr=1.5, goodput=30.0)
+    # protected-lane tail and MTTR growing, goodput shrinking: three
+    # regressions, each in its own direction
+    worse = _storm_row(p99=1.8, mttr=2.5, goodput=20.0)
+    summary = bd.diff_rows(old, worse, threshold=0.15)
+    assert summary["ok"] is False
+    msgs = [f["msg"] for f in summary["findings"]]
+    assert any("storm_high_p99_s" in m and "lower-is-better" in m for m in msgs)
+    assert any("storm_mttr_s" in m and "lower-is-better" in m for m in msgs)
+    assert any(
+        "storm_goodput_pods_per_s" in m and "higher-is-better" in m
+        for m in msgs
+    )
+    # the same deltas in the healthy direction are improvements
+    summary = bd.diff_rows(worse, old, threshold=0.15)
+    assert summary["ok"] is True and len(summary["improvements"]) == 3
+
+
+def test_bench_diff_storm_shed_counts_are_info_not_findings():
+    bd = _bench_diff_mod()
+    old = _storm_row(p99=0.9, mttr=1.5, goodput=30.0, shed_low=20)
+    new = _storm_row(p99=0.9, mttr=1.5, goodput=30.0, shed_low=80)
+    summary = bd.diff_rows(old, new, threshold=0.15)
+    assert summary["ok"] is True and summary["findings"] == []
+    assert any("storm_shed_low 20 -> 80" in line for line in summary["info"])
+    # but the exactly_once bit flipping is a parity finding, not info
+    broken = _storm_row(p99=0.9, mttr=1.5, goodput=30.0, exactly_once=False)
+    summary = bd.diff_rows(old, broken, threshold=0.15)
+    assert summary["ok"] is False
+    assert [f["kind"] for f in summary["findings"]] == ["parity"]
 
 
 # -- measured pipeline overlap -----------------------------------------------

@@ -582,12 +582,13 @@ def test_conf_trace_key_hot_reloads_the_switch(tmp_path):
 
 
 def test_span_names_registry_matches_reality():
-    """Every name the tree checker accepts is declared, and the four
+    """Every name the tree checker accepts is declared, and the five
     debug endpoints are exactly the declared surface (the KBT-R analyzer
     enforces the call-site side; this pins the registry's shape)."""
     assert len(obs.SPAN_NAMES) == len(set(obs.SPAN_NAMES))
     assert obs.DEBUG_ENDPOINTS == (
-        "/debug/trace", "/debug/slo", "/debug/explain", "/debug/fleet"
+        "/debug/trace", "/debug/slo", "/debug/explain", "/debug/fleet",
+        "/debug/admission",
     )
     bad = obs.check_tree([{
         "name": "not-a-span", "trace_id": "t", "span_id": "s",

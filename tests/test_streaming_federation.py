@@ -1,0 +1,313 @@
+"""Overload-hardened streaming federation (ISSUE 18 satellites): echo
+filtering over delta-patched mirrors, absorb-mode occupancy patches,
+overcommit-tolerant watch accounting, and pump hygiene.
+
+The regression class pinned here: federated shards mirror the store
+through the /backend/v1/ watch, and under protocol v2 MODIFIED events
+arrive as field-level deltas applied with ``wire.apply_delta``. The
+StreamTrigger's echo rules (bind echo closes the latency loop with no
+wake; status-only podgroup write-back must not re-dirty) depend on the
+mirror's replace-don't-mutate contract — ``apply_delta`` returning a
+*new* object while the handler still holds the old one. If a codec
+ever patched in place, every bind echo would look like a no-op update
+(old is new) and every podgroup status write like a spec change, and
+streaming would either stall the time_to_bind loop or re-dirty the
+whole resident world each cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import pytest
+
+from kube_batch_tpu import faults
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.apis import wire
+from kube_batch_tpu.cache import (
+    ClusterStore,
+    EventHandler,
+    LoopbackBackend,
+    SchedulerCache,
+)
+from kube_batch_tpu.cache.store import PODS, POD_GROUPS
+from kube_batch_tpu.server import SchedulerServer
+from kube_batch_tpu.streaming import StreamState, StreamTrigger
+from kube_batch_tpu.testing import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+    yield
+    faults.registry.reset()
+    faults.solver_ladder.reset()
+
+
+@pytest.fixture()
+def arbiter():
+    """A real SchedulerServer as the store process (its own loop idled
+    by a scheduler name no workload pod carries)."""
+    srv = SchedulerServer(
+        scheduler_name="store-arbiter", listen_address="127.0.0.1:0",
+        schedule_period=60.0,
+    )
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+# -- delta-codec echo filtering (satellite: v2 patched mirrors) --------------
+
+
+def test_delta_patched_bind_echo_keeps_old_new_distinct():
+    """A v2 bind echo (node_name ""->set as a field delta) applied with
+    apply_delta must produce a NEW object so the trigger still sees the
+    transition: arrival closed, no wake, no stale degrade."""
+    pending = build_pod(name="p0", group_name="g0",
+                        req=build_resource_list(cpu=1))
+    bound = dataclasses.replace(pending, node_name="n1")
+    delta = wire.delta_of(PODS, pending, bound)
+    # the hot-path promise: a bind rides as a fraction of the object
+    assert "node_name" in delta["changed"] and not delta["removed"]
+    patched = wire.apply_delta(PODS, pending, delta)
+    assert patched is not pending, "apply_delta must copy, not mutate"
+    assert pending.node_name == "" and patched.node_name == "n1"
+
+    trig = StreamTrigger(absorb_external=True)
+    uid = pending.metadata.uid
+    trig._on_event(PODS, uid, pending, None)
+    assert trig.backlog_pods() == 1
+    trig.drain()
+    # the echo, exactly as _apply_events hands it over: (old, patched)
+    trig._on_event(PODS, uid, patched, pending)
+    assert trig.backlog_pods() == 0, "bind echo must close the arrival"
+    assert not trig.wait(0), "bind echo must not wake the loop"
+    work = trig.drain()
+    assert not work.stale and not work.bound_patches
+
+
+def test_delta_patched_podgroup_status_echo_not_redirtied():
+    """close_session's status-only podgroup write-back, round-tripped
+    through the v2 delta codec, must keep spec equality so the trigger
+    skips it — and a real spec change through the same codec must not."""
+    from kube_batch_tpu.apis.types import PodGroupPhase
+
+    pg = build_pod_group("g1", min_member=3)
+    status2 = dataclasses.replace(
+        pg, status=dataclasses.replace(pg.status, phase=PodGroupPhase.RUNNING)
+    )
+    patched = wire.apply_delta(POD_GROUPS, pg, wire.delta_of(POD_GROUPS, pg, status2))
+    assert patched is not pg
+    assert patched.spec == pg.spec, "status delta must not disturb spec"
+
+    trig = StreamTrigger()
+    trig._on_event(POD_GROUPS, "default/g1", patched, pg)
+    assert not trig.wait(0) and trig.drain().gangs == set()
+
+    spec2 = dataclasses.replace(
+        pg, spec=dataclasses.replace(pg.spec, min_member=5)
+    )
+    patched2 = wire.apply_delta(POD_GROUPS, pg, wire.delta_of(POD_GROUPS, pg, spec2))
+    assert patched2.spec.min_member == 5
+    trig._on_event(POD_GROUPS, "default/g1", patched2, pg)
+    assert trig.wait(0) and trig.drain().gangs == {"default/g1"}
+
+
+# -- absorb mode (federated streaming) ---------------------------------------
+
+
+def test_absorb_mode_turns_peer_churn_into_patches_not_degrade():
+    """A peer shard's bind crosses the federated filter as a bound-pod
+    ADD (no wake: consumed capacity admits nothing) and its release as
+    a DELETE (wake: freed capacity can admit the backlog). Without
+    absorb mode both degrade to a stale full cycle."""
+    peer = build_pod(name="peer-0", node_name="n2",
+                     req=build_resource_list(cpu=1))
+    key = peer.metadata.uid
+
+    trig = StreamTrigger(absorb_external=True)
+    trig._on_event(PODS, key, peer, None)
+    assert not trig.wait(0), "peer bind must not wake the loop"
+    work = trig.drain()
+    assert work.bound_patches == [("add", key, peer)] and not work.stale
+
+    trig._on_event(PODS, key, None, peer)
+    assert trig.wait(0), "peer release frees capacity: wake"
+    work = trig.drain()
+    assert work.bound_patches == [("remove", key, peer)] and not work.stale
+
+    # contrast: a solo (non-federated) trigger treats both as stale
+    solo = StreamTrigger()
+    solo._on_event(PODS, key, peer, None)
+    work = solo.drain()
+    assert work.stale and "appeared outside a cycle" in work.stale_reason
+
+
+def _resident(cpu: int = 4) -> tuple[StreamState, NodeInfo]:
+    ni = NodeInfo(build_node(
+        "n0", build_resource_list(cpu=cpu, memory=f"{cpu}Gi", pods=16)
+    ))
+
+    class _Session:
+        nodes = {"n0": ni}
+
+    st = StreamState()
+    st.adopt_full_cycle(_Session())
+    return st, ni
+
+
+def test_apply_bound_patches_absorbs_and_skips_duplicates():
+    st, ni = _resident(cpu=4)
+    peer = build_pod(name="peer-1", node_name="n0",
+                     req=build_resource_list(cpu=1, memory="512Mi"))
+    idle0 = ni.idle.milli_cpu
+    assert st.apply_bound_patches([("add", "k", peer)]) is True
+    assert ni.idle.milli_cpu == idle0 - 1000 and len(ni.tasks) == 1
+    # duplicate add: the adopted snapshot beat the patch — benign skip
+    assert st.apply_bound_patches([("add", "k", peer)]) is True
+    assert len(ni.tasks) == 1 and st.valid
+    assert st.apply_bound_patches([("remove", "k", peer)]) is True
+    assert ni.idle.milli_cpu == idle0 and not ni.tasks
+    # duplicate remove: already gone — benign skip, still valid
+    assert st.apply_bound_patches([("remove", "k", peer)]) is True
+    assert st.valid
+
+
+def test_apply_bound_patches_invalidates_on_true_divergence():
+    # unknown node: the resident table genuinely diverged
+    st, _ = _resident()
+    ghost = build_pod(name="g", node_name="nowhere",
+                      req=build_resource_list(cpu=1))
+    assert st.apply_bound_patches([("add", "k", ghost)]) is False
+    assert not st.valid and "not resident" in st.reason
+
+    # resource underflow: the absorb path keeps the strict accounting
+    # raise (unlike the cache's watch path) — degrade to a full rebuild
+    st, _ = _resident(cpu=1)
+    fat = build_pod(name="fat", node_name="n0",
+                    req=build_resource_list(cpu=2))
+    assert st.apply_bound_patches([("add", "k", fat)]) is False
+    assert not st.valid
+
+
+# -- overcommit-tolerant watch accounting ------------------------------------
+
+
+def test_watch_delivered_bind_race_records_negative_idle_and_heals():
+    """Two shards race binds onto one node; the loser's cache receives
+    both as watch facts. The mirror must record the overcommit (idle
+    goes negative — unfit to every admission check) instead of killing
+    the pump, and a per-cycle clone of the oversubscribed node must not
+    abort the cycle. Deleting one pod heals the accounting exactly."""
+    store = ClusterStore()
+    store.create_queue(build_queue("default"))
+    store.create_node(build_node("tiny", build_resource_list(
+        cpu=1, memory="1Gi", pods=8)))
+    cache = SchedulerCache(store)
+    for i in range(2):
+        store.create_pod(build_pod(
+            name=f"winner-{i}", node_name="tiny",
+            req=build_resource_list(cpu=1, memory="512Mi"),
+        ))
+    with cache._mutex:
+        ni = cache.nodes["tiny"]
+        assert len(ni.tasks) == 2, "both committed binds must be resident"
+        assert ni.idle.milli_cpu == -1000, "overcommit must read as negative idle"
+        clone = ni.clone()  # the cycle snapshot must survive the replay
+        assert clone.idle.milli_cpu == -1000
+    store.delete_pod("default", "winner-1")
+    with cache._mutex:
+        ni = cache.nodes["tiny"]
+        assert len(ni.tasks) == 1 and ni.idle.milli_cpu == 0
+
+
+# -- pump hygiene (satellite: shutdown + handler survival) -------------------
+
+
+def test_backend_pump_thread_shutdown_hygiene(arbiter):
+    """start() spawns exactly one kb-backend thread; stop() joins it
+    and clears the handle; both are idempotent. A leaked pump thread
+    keeps long-polling a dead arbiter forever."""
+    backend = LoopbackBackend(f"http://127.0.0.1:{arbiter.listen_port}")
+    seen: list[str] = []
+    backend.add_event_handler(
+        PODS, EventHandler(on_add=lambda obj: seen.append(obj.name))
+    )
+    backend.start(period=0.02)
+    t = backend._thread
+    assert t is not None and t.is_alive()
+    backend.start(period=0.02)
+    assert backend._thread is t, "double start must not spawn a second pump"
+    arbiter.store.create_pod(build_pod(name="live", req=build_resource_list(cpu=1)))
+    deadline = time.monotonic() + 5.0
+    while "live" not in seen and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert "live" in seen
+    backend.stop()
+    assert backend._thread is None and not t.is_alive()
+    backend.stop()  # idempotent
+    assert backend._thread is None
+
+
+def test_trigger_attach_detach_restores_listener_count():
+    from kube_batch_tpu.ops import encode_cache
+
+    before = encode_cache.listener_count()
+    trig = StreamTrigger()
+    trig.attach()
+    assert encode_cache.listener_count() == before + 1
+    trig.detach()
+    assert encode_cache.listener_count() == before
+    trig.detach()  # idempotent
+    assert encode_cache.listener_count() == before
+
+
+def test_bad_handler_does_not_kill_the_pump(arbiter):
+    """One handler raising on an event must not stall the watch for
+    every other subscriber (the pump is shared infrastructure): later
+    handlers still run, the batch still counts, later pumps still
+    deliver."""
+    backend = LoopbackBackend(f"http://127.0.0.1:{arbiter.listen_port}")
+
+    def explode(obj):
+        raise ValueError(f"poison object {obj.name}")
+
+    seen: list[str] = []
+    backend.add_event_handler(PODS, EventHandler(on_add=explode))
+    backend.add_event_handler(
+        PODS, EventHandler(on_add=lambda obj: seen.append(obj.name))
+    )
+    arbiter.store.create_pod(build_pod(name="a", req=build_resource_list(cpu=1)))
+    assert backend.pump() >= 1
+    assert seen == ["a"], "the handler after the poisoned one must still run"
+    arbiter.store.create_pod(build_pod(name="b", req=build_resource_list(cpu=1)))
+    assert backend.pump() >= 1
+    assert seen == ["a", "b"], "the pump must survive to the next round"
+
+
+def test_stream_pump_fault_skips_rounds_then_redelivers(arbiter):
+    """An armed ``stream.pump`` drops whole rounds (mirror ages, no
+    partial batches); once exhausted, the unadvanced cursor redelivers
+    everything exactly once."""
+    backend = LoopbackBackend(f"http://127.0.0.1:{arbiter.listen_port}")
+    seen: list[str] = []
+    backend.add_event_handler(
+        PODS, EventHandler(on_add=lambda obj: seen.append(obj.name))
+    )
+    arbiter.store.create_pod(build_pod(name="held", req=build_resource_list(cpu=1)))
+    faults.registry.arm("stream.pump", count=2)
+    assert backend.pump() == 0 and backend.pump() == 0
+    assert seen == [], "a dropped round must not leak a partial batch"
+    assert backend.pump() >= 1
+    assert seen == ["held"], "exhausted fault must redeliver exactly once"
