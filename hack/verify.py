@@ -133,6 +133,7 @@ import os
 import subprocess
 import sys
 import tabnanny
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGETS = ["kube_batch_tpu", "tests", "bench.py", "__graft_entry__.py", "hack"]
@@ -690,6 +691,47 @@ def run_analysis_gate(strict: bool) -> dict:
     }
 
 
+def run_threads_gate(strict: bool) -> dict:
+    """The concurrency sanitizer as its own gate (python -m
+    kube_batch_tpu.analysis.threads): beyond the KBT-T pass the default
+    suite already runs, the dedicated CLI also executes the seeded
+    fixture self-check AND the RaceWitness determinism drills, so a
+    regression in either detector fails the build even while the live
+    tree is clean."""
+    import json
+
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.analysis.threads", "--json"]
+        + (["--strict"] if strict else []),
+        cwd=REPO, capture_output=True, text=True,
+    )
+    summary: dict = {"ok": False, "counts": {}}
+    try:
+        summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print("verify: threads analyzer produced no parseable summary")
+        print(res.stdout, res.stderr, sep="\n")
+    ok = res.returncode == 0 and summary.get("ok", False)
+    self_probs = summary.get("selfcheck") or {}
+    problems = list(self_probs.get("static", ["?"])) + list(
+        self_probs.get("witness", [])
+    )
+    if not ok:
+        for f in summary.get("findings", []) + summary.get("baseline_errors", []):
+            print(f"{f['path']}:{f['line']}: {f['code']} {f['message']}")
+        for p in problems:
+            print(f"selfcheck: {p}")
+        print("verify: concurrency sanitizer FAILED "
+              "(python -m kube_batch_tpu.analysis.threads --explain CODE)")
+    return {
+        "ok": ok,
+        "counts": summary.get("counts", {}),
+        "suppressed": summary.get("suppressed", 0),
+        "selfcheck_ok": not problems,
+        "stale": len(summary.get("stale", [])),
+    }
+
+
 def run_trace_gate(strict: bool) -> dict:
     """The jaxpr-level trace auditor (python -m
     kube_batch_tpu.analysis.trace) under JAX_PLATFORMS=cpu. Same
@@ -769,6 +811,24 @@ def run_interleave_gate(strict: bool) -> dict:
     }
 
 
+class _TimedGates(dict):
+    """Gate-summary dict that stamps per-gate wall-clock (seconds since
+    the previous gate finished) onto each entry as it is recorded, so
+    slow gates (interleave, chaos) are visible in the ``--json``
+    machine summary without touching every call site."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._mark = time.perf_counter()
+
+    def __setitem__(self, key, value):
+        now = time.perf_counter()
+        if isinstance(value, dict) and "seconds" not in value:
+            value = dict(value, seconds=round(now - self._mark, 3))
+        self._mark = now
+        super().__setitem__(key, value)
+
+
 def main(argv: list[str] | None = None) -> int:
     import json
 
@@ -814,7 +874,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     files = py_files()
     failed = False
-    gates: dict = {}
+    gates: dict = _TimedGates()
 
     # 1. syntax
     ok = compileall.compile_dir(
@@ -856,6 +916,13 @@ def main(argv: list[str] | None = None) -> int:
     # baseline entries)
     gates["analysis"] = run_analysis_gate(strict)
     if not gates["analysis"]["ok"]:
+        failed = True
+
+    # 4a. the concurrency sanitizer's own CLI (KBT-T0xx + RaceWitness):
+    # runs the seeded fixture self-check and the witness determinism
+    # drills on top of the live-tree pass the suite gate above did
+    gates["threads"] = run_threads_gate(strict)
+    if not gates["threads"]["ok"]:
         failed = True
 
     # 4b. the trace-level program auditor (KBT-P0xx): jaxpr lints +

@@ -435,8 +435,8 @@ class AdmissionGate:
             age_fn if age_fn is not None
             else (lambda: metrics.watch_snapshot_age.value())
         )
-        self._last_tick = clock()
-        self._inflight_keys: dict[str, str] = {}
+        self._last_tick = clock()  #: guarded_by _lock
+        self._inflight_keys: dict[str, str] = {}  #: guarded_by _lock
 
     # -- controller plumbing --------------------------------------------------
 

@@ -39,6 +39,15 @@ stdlib-only (ast-based) so the bare container runs the full gate:
   breaker tier transitions, StreamState harvest->patch->invalidate->
   re-harvest) checked path-structurally per function, plus listener
   register/remove pairing on teardown paths.
+- **A7 concurrency sanitizer** (:mod:`.threads`, KBT-T0xx, also its
+  own CLI ``python -m kube_batch_tpu.analysis.threads``): thread/pool
+  lifecycle discipline (every construction needs a reachable bounded
+  join/shutdown or a daemon annotation), shared-state escape (an
+  unguarded ``self.<field>`` written in one inferred thread root's
+  call closure and touched in another's), and split read-modify-write
+  across two regions of one lock. Shares A1's ``#: guarded_by``
+  declaration surface; its runtime sibling is the vector-clock
+  :class:`~kube_batch_tpu.utils.race.RaceWitness`.
 
 A jax-dependent sibling, the **trace-time auditor**
 (:mod:`kube_batch_tpu.analysis.trace`, KBT-P0xx, its own CLI
@@ -362,6 +371,48 @@ CODES: dict[str, tuple[str, str]] = {
         "registration and the protecting try is one exception away "
         "from the leak.",
     ),
+    "KBT-T001": (
+        "thread/pool without a reachable bounded shutdown path",
+        "A threading.Thread or executor pool is constructed with no "
+        "reachable bounded join(timeout=...)/shutdown() on its binding "
+        "and no daemon=True annotation — or is only ever joined without "
+        "a timeout. A wedged worker then outlives its owner and hangs "
+        "process teardown (the watch pump, lease renewer and scrape "
+        "loops all shut down under deadline budgets). Fix: add a "
+        "stop()+join(timeout=...) path (idempotent on double-stop), use "
+        "`with ThreadPoolExecutor(...)`, or mark daemon=True where a "
+        "supervisor polls liveness. Ownership transfers (returning the "
+        "thread, passing it to a call) end the obligation at the "
+        "construction site.",
+    ),
+    "KBT-T002": (
+        "unguarded field escapes to multiple thread roots",
+        "A self.<field> with no declared guard (KBT-L seed map or "
+        "`#: guarded_by` annotation) is written in one inferred thread "
+        "root's call closure and touched in another's — or written from "
+        "a multi-instance root (a pool callable, a thread started in a "
+        "loop). Thread roots are inferred from Thread(target=...)/"
+        "submit(...) sites plus the seed-root map for dynamic dispatch "
+        "(HTTP handler threads, write-pool callbacks); everything else "
+        "is the owning `(callers)` root. Unordered cross-root access is "
+        "a data race: torn reads, lost updates, stale decisions. Fix: "
+        "annotate `#: guarded_by <lock>` on the field's __init__ line "
+        "(KBT-L then enforces every touch) and take the lock, or "
+        "confine the field to one thread and baseline with the "
+        "confinement argument.",
+    ),
+    "KBT-T003": (
+        "read-modify-write split across two lock regions",
+        "A guarded field is read under its lock in one `with` region "
+        "and written back under a *different* region of the same lock "
+        "in the same function, with no re-read before the write. Both "
+        "accesses hold the lock, so KBT-L is satisfied — but the "
+        "modify step between the regions runs unlocked, and another "
+        "thread's update in the window is silently overwritten "
+        "(check-then-act on stale state). Fix: merge the two regions "
+        "into one critical section, or re-read/validate the field "
+        "under the writing lock before storing.",
+    ),
     "KBT-I001": (
         "interleaving counterexample",
         "The interleaving model checker "
@@ -604,6 +655,7 @@ def run_suite(
         protocol,
         registry_consistency,
         snapshot_escape,
+        threads,
     )
 
     repo = repo or repo_root()
@@ -616,6 +668,7 @@ def run_suite(
         protocol.analyze,
         jax_hazards.analyze,
         snapshot_escape.analyze,
+        threads.analyze,
     ]
     for analyze in analyzers:
         findings.extend(analyze(files))

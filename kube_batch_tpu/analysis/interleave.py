@@ -300,6 +300,19 @@ class Scenario:
         self.cache._mutex = self.witness.wrap(L_CACHE, self.cache._mutex)
         self.trigger._lock = self.witness.wrap(L_TRIG, self.trigger._lock)
         self.journal._lock = self.witness.wrap(L_JOURNAL, self.journal._lock)
+        # Field-level witness over the lockless resident table: every
+        # actual StreamState access reports as the STATE token, so a
+        # step that touches it without declaring STATE in its footprint
+        # is caught the same way an undeclared lock acquire is (the
+        # explorer's on_access hook feeds the same observed set).
+        from kube_batch_tpu.utils.race import RaceWitness
+
+        self.race = RaceWitness(clock=self.clock.now)
+        self.race.watch(
+            self.state,
+            {"nodes": "touch", "valid": "rw", "reason": "rw"},
+            token=STATE,
+        )
         for b in faults.solver_ladder.breakers.values():
             self._orig_breaker_clocks[b] = b._clock
             b._clock = self.clock.now
@@ -1268,6 +1281,26 @@ class AdmissionStorm(Scenario):
         return out
 
 
+class UnderdeclaredState(Scenario):
+    name = "underdeclared_state"
+    describe = (
+        "FIXTURE (intentionally broken): a step reads the lockless "
+        "resident table (StreamState.valid) without declaring the "
+        "STATE token in its footprint — the field-level RaceWitness "
+        "upgrades the under-declaration into a KBT-I002 model error "
+        "that pure lock-acquire observation could never see"
+    )
+    parity = False  # the seeded violation aborts fingerprinting
+
+    def build(self) -> None:
+        self._wire(nodes=2)
+        self.threads = [
+            # footprint claims trigger-lock only; the body touches the
+            # watched resident table -> observed {STATE} ⊄ F_TRIG
+            [Step("peek_state", lambda: self.state.valid, F_TRIG)],
+        ]
+
+
 SCENARIOS = {
     c.name: c
     for c in (
@@ -1281,7 +1314,10 @@ SCENARIOS = {
         AdmissionStorm,
     )
 }
-FIXTURES = {BrokenDrain.name: BrokenDrain}
+FIXTURES = {
+    BrokenDrain.name: BrokenDrain,
+    UnderdeclaredState.name: UnderdeclaredState,
+}
 
 
 # -- explorer -----------------------------------------------------------------
@@ -1310,6 +1346,11 @@ def _run_schedule(scn_cls, root: str, order, trace: str, verbose: bool = False) 
                 observed.setdefault(cursor["i"], set()).add(name)
 
         scn.witness.on_acquire = on_acquire
+        race = getattr(scn, "race", None)
+        if race is not None:
+            # field-level: actual watched-state accesses (STATE et al.)
+            # feed the same observed-vs-footprint check as lock acquires
+            race.on_access = on_acquire
         pos = [0] * len(scn.threads)
         for i, tid in enumerate(order):
             step = scn.threads[tid][pos[tid]]
@@ -1332,8 +1373,8 @@ def _run_schedule(scn_cls, root: str, order, trace: str, verbose: bool = False) 
             if extra:
                 result.violations.append(
                     f"model error: step {step.name} acquired undeclared "
-                    f"lock(s) {extra} — footprint under-declared, DPOR "
-                    "pruning would be unsound"
+                    f"lock(s)/state token(s) {extra} — footprint "
+                    "under-declared, DPOR pruning would be unsound"
                 )
         result.violations.extend(scn.witness.violations)
         result.violations.extend(scn.invariants())

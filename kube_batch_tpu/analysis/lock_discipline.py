@@ -92,10 +92,69 @@ SEED_GUARDED: dict[str, dict[str, dict[str, str]]] = {
         # streaming-loop-thread-confined by design.
         "StreamTrigger": {
             "_gangs": "_lock",
+            "_bound_patches": "_lock",
             "_node_patches": "_lock",
             "_arrivals": "_lock",
+            "_queues": "_lock",
             "_stale": "_lock",
             "_stale_reason": "_lock",
+        },
+    },
+    # Post-PR-4 threaded modules (PR 19): each also self-documents via
+    # `#: guarded_by` annotations on its __init__ lines — the seed
+    # entries below keep KBT-L and KBT-T anchored to one declaration
+    # surface even if an annotation is dropped in a refactor.
+    "kube_batch_tpu/admission.py": {
+        "AdmissionGate": {
+            "_last_tick": "_lock",
+            "_inflight_keys": "_lock",
+        },
+    },
+    "kube_batch_tpu/obs/fleet.py": {
+        "FleetAggregator": {
+            "_last_mono": "_lock",
+            "_prev_nodes": "_lock",
+            "_prev_binds": "_lock",
+            "_prev_binds_mono": "_lock",
+            "_last_seen": "_lock",
+            "_payload_cache": "_lock",
+            "last": "_lock",
+        },
+    },
+    "kube_batch_tpu/pipeline.py": {
+        "DispatchFence": {
+            "_future": "_lock",
+            "_dispatch_s": "_lock",
+            "_dispatch_t0": "_lock",
+            "_dispatch_t1": "_lock",
+            "_overlap_fresh": "_lock",
+            "last_overlap_fraction": "_lock",
+            "degraded_reason": "_lock",
+        },
+    },
+    "kube_batch_tpu/federation.py": {
+        "ShardSlotManager": {
+            "_owned": "_lock",
+            "_adoption_order": "_lock",
+            "_reclaiming": "_lock",
+            "_last_conflicts": "_lock",
+        },
+    },
+    "kube_batch_tpu/cache/backend.py": {
+        "LoopbackBackend": {
+            "_mirror": "_lock",
+            "_cursor": "_lock",
+            "_synced": "_lock",
+            "_store_version": "_lock",
+            "_last_pump_ok": "_lock",
+        },
+    },
+    "kube_batch_tpu/recovery/watch_client.py": {
+        "ResilientWatcher": {
+            "mirror": "_lock",
+            "_rv": "_lock",
+            "_last_sync": "_lock",
+            "_last_relist": "_lock",
         },
     },
     "kube_batch_tpu/utils/workqueue.py": {

@@ -204,13 +204,13 @@ class LoopbackBackend:
                 CODEC_ENV, self._codec_pref, "/".join(wire.CODECS),
             )
             self._codec_pref = "json"
-        self._protocol: Optional[int] = None  # None = not yet negotiated
-        self._codec = "json"
-        self._features: frozenset[str] = frozenset()
+        self._protocol: Optional[int] = None  #: guarded_by _lock (None = not yet negotiated)
+        self._codec = "json"  #: guarded_by _lock
+        self._features: frozenset[str] = frozenset()  #: guarded_by _lock
         # Any partition (real or injected) forces renegotiation on the
         # next request: the peer we reconnect to after a partition may be
         # a different (older or newer) server build.
-        self._needs_negotiation = True
+        self._needs_negotiation = True  #: guarded_by _lock
         parsed = urllib.parse.urlsplit(self.base_url)
         self._pool = _ConnectionPool(
             parsed.hostname or "localhost",
@@ -221,22 +221,22 @@ class LoopbackBackend:
         # Cumulative protocol bytes (tx/rx) for bench rows; the metric
         # family store_backend_bytes_total is process-global, these are
         # per-backend so a bench can report wire_bytes_per_bind per row.
-        self.bytes_tx = 0
-        self.bytes_rx = 0
+        self.bytes_tx = 0  #: guarded_by _lock
+        self.bytes_rx = 0  #: guarded_by _lock
         self._lock = threading.RLock()
-        self._mirror: dict[str, dict[str, Any]] = {k: {} for k in self.kinds}
+        self._mirror: dict[str, dict[str, Any]] = {k: {} for k in self.kinds}  #: guarded_by _lock
         self._handlers: dict[str, list[EventHandler]] = {k: [] for k in self.kinds}
         # Per-kind watch cursor: the server's rv is a global sequence but
         # rings are per kind, so a cursor advanced by one kind's poll must
         # never be reused for another kind (it would skip that kind's
         # events below it).
-        self._cursor: dict[str, int] = {k: 0 for k in self.kinds}
-        self._synced: dict[str, bool] = {k: False for k in self.kinds}
+        self._cursor: dict[str, int] = {k: 0 for k in self.kinds}  #: guarded_by _lock
+        self._synced: dict[str, bool] = {k: False for k in self.kinds}  #: guarded_by _lock
         # Last storeVersion any reply carried: the `version` property's
         # fallback when the backend is partitioned (snapshot() must not
         # fail just because version couldn't be refreshed).
-        self._store_version = 0
-        self._last_pump_ok = time.monotonic()
+        self._store_version = 0  #: guarded_by _lock
+        self._last_pump_ok = time.monotonic()  #: guarded_by _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -561,11 +561,14 @@ class LoopbackBackend:
                     continue
                 dispatched += self._apply_events(kind, payload.get("events", []))
                 with self._lock:
-                    self._cursor[kind] = int(payload["resourceVersion"])
+                    # absolute server-issued rv; only the pump thread
+                    # advances cursors between list re-seeds
+                    self._cursor[kind] = int(payload["resourceVersion"])  # noqa: KBT-T003
         except BackendPartitioned as e:
             log.V(3).infof("backend pump skipped: %s", e)
             return dispatched
-        self._last_pump_ok = time.monotonic()
+        with self._lock:
+            self._last_pump_ok = time.monotonic()
         return dispatched
 
     def _pump_v2(self, timeout: float = 0.0) -> int:
@@ -588,7 +591,7 @@ class LoopbackBackend:
             payload = self._request("watch", "GET", path, not_found_ok=True)
             rv = int(payload["resourceVersion"])
             for kind, res in payload.get("kinds", {}).items():
-                if kind not in self._mirror:
+                if kind not in self.kinds:  # mirror keys == kinds, fixed at init
                     continue
                 if res.get("status") == "gone":
                     dispatched += self._relist(kind)
@@ -598,11 +601,12 @@ class LoopbackBackend:
                 # every kind's events — safe to advance all polled
                 # cursors to it in one go.
                 with self._lock:
-                    self._cursor[kind] = rv
+                    self._cursor[kind] = rv  # noqa: KBT-T003 (absolute server rv)
         except BackendPartitioned as e:
             log.V(3).infof("backend pump skipped: %s", e)
             return dispatched
-        self._last_pump_ok = time.monotonic()
+        with self._lock:
+            self._last_pump_ok = time.monotonic()
         return dispatched
 
     def _relist(self, kind: str) -> int:
@@ -683,7 +687,8 @@ class LoopbackBackend:
     def snapshot_age(self) -> float:
         """Seconds since the last fully-successful pump — the
         staleness_fn the cache's refuse-to-schedule guard reads."""
-        return max(0.0, time.monotonic() - self._last_pump_ok)
+        with self._lock:
+            return max(0.0, time.monotonic() - self._last_pump_ok)
 
     # -- reads (mirror) ----------------------------------------------------
 

@@ -416,7 +416,14 @@ class StoreVolumeBinder:
             )
         task.volume_ready = True
         with self._lock:
-            self._assumed.pop(task.uid, None)
+            # Re-read under the writing lock: only retire the entries we
+            # actually bound — a concurrent assume may have added more.
+            rec = self._assumed.get(task.uid)
+            if rec is not None:
+                for pvc_key in assumed:
+                    rec.pop(pvc_key, None)
+                if not rec:
+                    self._assumed.pop(task.uid, None)
             for pv_name in assumed.values():
                 self._reserved.pop(pv_name, None)
 

@@ -334,7 +334,7 @@ class ShardSlotManager:
         self._owned: set[int] = set()  #: guarded_by _lock
         self._adoption_order: list[int] = []  #: guarded_by _lock
         self._reclaiming = False  #: guarded_by _lock
-        self._last_conflicts = 0.0
+        self._last_conflicts = 0.0  #: guarded_by _lock
         self._breaker = faults.CircuitBreaker(
             f"shard-adopt-{self.primary}", failure_threshold=3, reset_timeout=2.0
         )
@@ -570,7 +570,9 @@ class ShardSlotManager:
                 owned = set(self._owned) | {slot}
             change = self.cache.set_owned_slots(owned)
             with self._lock:
-                self._owned = set(owned)
+                # merge, don't overwrite: a concurrent handoff may have
+                # retired another slot while set_owned_slots ran
+                self._owned = set(self._owned) | {slot}
                 self._adoption_order.append(slot)
             self._publish_owned(owned)
             self._notify(change["adopted_gangs"], change["removed_gangs"])
@@ -640,7 +642,9 @@ class ShardSlotManager:
             metrics.register_shard_handoff("aborted")
             return False
         with self._lock:
-            self._owned = set(owned)
+            # merge, don't overwrite: a concurrent adopt may have added
+            # another slot while we drained this one
+            self._owned = set(self._owned) - {slot}
             if slot in self._adoption_order:
                 self._adoption_order.remove(slot)
         self._publish_owned(owned)
@@ -709,8 +713,9 @@ class ShardSlotManager:
             return
         fn = self._conflict_fn or _process_conflicts_total
         total = float(fn())
-        delta, self._last_conflicts = total - self._last_conflicts, total
         with self._lock:
+            delta = total - self._last_conflicts
+            self._last_conflicts = total
             owned = set(self._owned)
             order = list(self._adoption_order)
         slot = plan_rebalance(owned, self.primary, order, delta, self.rebalance)

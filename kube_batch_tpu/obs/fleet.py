@@ -184,13 +184,13 @@ class FleetAggregator:
             min_interval_s if min_interval_s is not None else self.MIN_INTERVAL_S
         )
         self._lock = threading.Lock()
-        self._last_mono = 0.0
-        self._prev_nodes: dict[str, float] = {}
-        self._prev_binds: float | None = None
-        self._prev_binds_mono = 0.0
-        self._last_seen: dict[str, float] = {}  # peer url -> last good scrape
-        self._payload_cache: dict[str, tuple[float, dict]] = {}
-        self.last: dict = {}
+        self._last_mono = 0.0  #: guarded_by _lock
+        self._prev_nodes: dict[str, float] = {}  #: guarded_by _lock
+        self._prev_binds: float | None = None  #: guarded_by _lock
+        self._prev_binds_mono = 0.0  #: guarded_by _lock
+        self._last_seen: dict[str, float] = {}  #: guarded_by _lock (peer url -> last good scrape)
+        self._payload_cache: dict[str, tuple[float, dict]] = {}  #: guarded_by _lock
+        self.last: dict = {}  #: guarded_by _lock
 
     def scrape(self, base_url: str, timeout: float | None = None) -> dict | None:
         url = base_url.rstrip("/") + "/debug/slo?raw=1"

@@ -102,15 +102,15 @@ class DispatchFence:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._future: Optional[Future] = None
-        self._dispatch_s = 0.0
-        self._dispatch_t0 = 0.0
-        self._dispatch_t1 = 0.0
+        self._future: Optional[Future] = None  #: guarded_by _lock
+        self._dispatch_s = 0.0  #: guarded_by _lock
+        self._dispatch_t0 = 0.0  #: guarded_by _lock
+        self._dispatch_t1 = 0.0  #: guarded_by _lock
         # one overlap sample per dispatch window: the FIRST join after a
         # window records it, later joins of the same window do not
-        self._overlap_fresh = False
-        self.last_overlap_fraction: Optional[float] = None
-        self.degraded_reason: Optional[str] = None
+        self._overlap_fresh = False  #: guarded_by _lock
+        self.last_overlap_fraction: Optional[float] = None  #: guarded_by _lock
+        self.degraded_reason: Optional[str] = None  #: guarded_by _lock
 
     def arm(self, future: Future) -> None:
         with self._lock:
@@ -146,23 +146,25 @@ class DispatchFence:
                 return
             d0, d1 = self._dispatch_t0, self._dispatch_t1
             self._overlap_fresh = False
-        blocked = max(0.0, min(w1, d1) - max(w0, d0))
-        fraction = max(0.0, min(1.0, 1.0 - blocked / (d1 - d0)))
-        self.last_overlap_fraction = fraction
+            blocked = max(0.0, min(w1, d1) - max(w0, d0))
+            fraction = max(0.0, min(1.0, 1.0 - blocked / (d1 - d0)))
+            self.last_overlap_fraction = fraction
         metrics.set_pipeline_overlap_fraction(fraction)
 
     def degrade(self, reason: str) -> None:
         """Sticky: flips :func:`enabled` false for the process, loudly."""
-        if self.degraded_reason is None:
+        with self._lock:
+            if self.degraded_reason is not None:
+                return
             self.degraded_reason = reason
-            log.errorf(
-                "pipeline degraded to synchronous cycles: %s "
-                "(sticky until pipeline.reset())", reason,
-            )
-            metrics.register_degraded_cycle("pipeline", reason.split(":")[0])
-            from kube_batch_tpu import obs
+        log.errorf(
+            "pipeline degraded to synchronous cycles: %s "
+            "(sticky until pipeline.reset())", reason,
+        )
+        metrics.register_degraded_cycle("pipeline", reason.split(":")[0])
+        from kube_batch_tpu import obs
 
-            obs.recorder.dump(reason="pipeline.degraded", min_interval_s=5.0)
+        obs.recorder.dump(reason="pipeline.degraded", min_interval_s=5.0)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Join the in-flight deferred dispatch. True = clean (or
@@ -194,11 +196,12 @@ class DispatchFence:
             ok = False
             self.degrade(f"deferred dispatch raised {type(e).__name__}: {e}")
             with self._lock:
-                self._future = None
+                if self._future is fut:  # a newer future may be armed
+                    self._future = None
         t1 = time.perf_counter()
         metrics.observe_pipeline_fence_wait(t1 - t0)
         with self._lock:
-            if ok:
+            if ok and self._future is fut:  # don't drop a newer arm()
                 self._future = None
         if ok:
             self.record_join(t0, t1)
@@ -212,8 +215,8 @@ class DispatchFence:
             self._dispatch_t0 = 0.0
             self._dispatch_t1 = 0.0
             self._overlap_fresh = False
-        self.last_overlap_fraction = None
-        self.degraded_reason = None
+            self.last_overlap_fraction = None
+            self.degraded_reason = None
         if fut is not None and not fut.done():
             try:
                 fut.result(timeout=fence_timeout_s())
@@ -261,5 +264,12 @@ def join_session(ssn, timeout: Optional[float] = None) -> None:
 
 
 def reset() -> None:
-    """Clear fence + degradation state (test hygiene between drills)."""
+    """Clear fence + degradation state (test hygiene between drills),
+    and retire the lazy fallback thread so drills do not leak it."""
+    global _fallback
+    with _fallback_lock:
+        pool = _fallback
+        _fallback = None
+    if pool is not None:
+        pool.shutdown(wait=True)
     fence.reset()

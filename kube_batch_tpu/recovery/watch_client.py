@@ -67,10 +67,10 @@ class ResilientWatcher:
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
         # kind -> {obj_key: serialized object} — the mirror
-        self.mirror: dict[str, dict[str, dict]] = {k: {} for k in self.kinds}
-        self._rv: dict[str, int] = {k: 0 for k in self.kinds}
-        self._last_sync: dict[str, Optional[float]] = {k: None for k in self.kinds}
-        self._last_relist: dict[str, float] = {k: 0.0 for k in self.kinds}
+        self.mirror: dict[str, dict[str, dict]] = {k: {} for k in self.kinds}  #: guarded_by _lock
+        self._rv: dict[str, int] = {k: 0 for k in self.kinds}  #: guarded_by _lock
+        self._last_sync: dict[str, Optional[float]] = {k: None for k in self.kinds}  #: guarded_by _lock
+        self._last_relist: dict[str, float] = {k: 0.0 for k in self.kinds}  #: guarded_by _lock
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -86,11 +86,17 @@ class ResilientWatcher:
         relist_min_interval window the call waits for the window to
         close first (the storm damper)."""
         now = time.monotonic()
-        wait = self._last_relist[kind] + self.relist_min_interval - now
+        with self._lock:
+            wait = self._last_relist[kind] + self.relist_min_interval - now
         if wait > 0:
-            if self._stop.wait(wait):
+            if self._stop.wait(wait):  # blocking wait stays outside the lock
                 return
-        self._last_relist[kind] = time.monotonic()
+        with self._lock:
+            # max(): a concurrent direct list_kind call may have stamped
+            # the window while we waited — never move the window backwards
+            self._last_relist[kind] = max(
+                self._last_relist[kind], time.monotonic()
+            )
         payload = self._get(f"/apis/v1alpha1/{kind}", timeout=self.poll_timeout + 5)
         with self._lock:
             self.mirror[kind] = {_obj_key(o): o for o in payload["items"]}
@@ -101,17 +107,22 @@ class ResilientWatcher:
     def poll_once(self, kind: str) -> str:
         """One watch long-poll; applies events. Returns "ok" | "gone"
         (410: the caller must re-list; the thread loop does)."""
+        with self._lock:
+            since = self._rv[kind]
         try:
             payload = self._get(
                 f"/apis/v1alpha1/watch/{kind}"
-                f"?since={self._rv[kind]}&timeout={self.poll_timeout}",
+                f"?since={since}&timeout={self.poll_timeout}",
                 timeout=self.poll_timeout + 5,
             )
         except urllib.error.HTTPError as e:
             if e.code == 410:
                 body = json.loads(e.read() or b"{}")
                 with self._lock:
-                    self._rv[kind] = int(body.get("resourceVersion", 0))
+                    # absolute resume point dictated by the server's 410
+                    # body, NOT derived from the rv we polled with — a
+                    # compaction may legitimately move it backwards
+                    self._rv[kind] = int(body.get("resourceVersion", 0))  # noqa: KBT-T003
                 return "gone"
             raise
         with self._lock:
@@ -122,7 +133,8 @@ class ResilientWatcher:
                     m.pop(key, None)
                 else:
                     m[key] = ev["object"]
-            self._rv[kind] = payload["resourceVersion"]
+            # absolute server-issued rv; one watch thread per kind
+            self._rv[kind] = payload["resourceVersion"]  # noqa: KBT-T003
         self._mark_sync(kind)
         return "ok"
 
@@ -178,6 +190,8 @@ class ResilientWatcher:
                 self._stop.wait(delay)
 
     def start(self) -> None:
+        if self._threads:  # idempotent: a second start must not double
+            return         # the watcher population
         self._stop.clear()
         for kind in self.kinds:
             t = threading.Thread(

@@ -49,3 +49,42 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import threading
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_threads():
+    """Thread-lifecycle discipline at test granularity (the dynamic twin
+    of the KBT-T001 static check): a test that starts a non-daemon
+    thread must stop/join it before returning, or interpreter shutdown
+    hangs on the whole suite's behalf.
+
+    Zero-cost on the common path: the grace join only runs when a NEW
+    non-daemon thread is still alive at teardown. Daemon leaks (pumps
+    whose stop() the test deliberately skipped) are tolerated here —
+    the analyzer's witness drive and the chaos suite police those.
+    """
+    from kube_batch_tpu.utils.race import leaked_threads, thread_snapshot
+
+    before = thread_snapshot()
+    yield
+    fresh_nondaemon = [
+        t for t in threading.enumerate()
+        if t.ident not in before
+        and not t.daemon
+        and t is not threading.current_thread()
+        and t.is_alive()
+    ]
+    if not fresh_nondaemon:
+        return
+    leaked = leaked_threads(before, grace_s=2.0, include_daemon=False)
+    if leaked:
+        pytest.fail(
+            "leaked non-daemon thread(s) past teardown: "
+            + ", ".join(t.name for t in leaked)
+            + " — every start() needs a reachable bounded join/stop path",
+            pytrace=False,
+        )
