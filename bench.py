@@ -64,6 +64,7 @@ from kube_batch_tpu.models import (
     preempt_contended,
     preempt_mix,
     synthetic,
+    uniform_pool,
 )
 from kube_batch_tpu.testing import (
     FakeCache,
@@ -1258,6 +1259,112 @@ def main() -> None:
     p400k["p50_speedup_vs_sync_pct"] = round(
         100.0 * (1.0 - p400k["p50_s"] / e400k["p50_s"]), 1
     )
+
+    # -- node-class compressed solve (ISSUE 20) ---------------------------
+    # The compression headline: the same snapshots solved with
+    # KBT_CLASS_COMPRESS=1, bind-for-bind parity asserted in-row against
+    # the uncompressed column, with the class table's own columns
+    # (class_count / compression_ratio / splits / segments and the
+    # group_s-vs-kernel_s solve-cost split) recorded from the action's
+    # last_class_stats — the honesty evidence that the solve ran at
+    # class granularity, not a silent fallback. `uniform_pool` is the
+    # high-duplication world (dozens of classes across 40k nodes, ~1%
+    # of nodes carrying churned residents); `preempt_mix` rides the
+    # same columns at the flagship mix. sessions=2 like the other
+    # auxiliary envelope rows — these are honesty columns, not tail
+    # percentile claims.
+    def class_columns(row):
+        action = get_action("xla_allocate")
+        row["solver"] = action.last_solver_tier
+        stats = dict(action.last_class_stats or {})
+        for k in ("class_count", "classes_valid", "splits", "remerges",
+                  "segments", "c_pad", "group_s", "kernel_s"):
+            if k in stats:
+                row["class_" + k] = stats[k]
+        if "compression_ratio" in stats:
+            # exact key name: bench_diff gates this one directionally
+            # (a shrink means the class key lost its duplication and
+            # the solve is drifting back toward per-node cost)
+            row["compression_ratio"] = stats["compression_ratio"]
+        return row
+
+    u400k = record(
+        "uniform_pool_400k_40k",
+        lambda: uniform_pool(400_000, 40_000, churn=0.01),
+        serial="none",
+        sessions=2,
+    )
+    u400k["solver"] = get_action("xla_allocate").last_solver_tier
+    u400kc = record(
+        "uniform_pool_400k_40k_classes",
+        lambda: uniform_pool(400_000, 40_000, churn=0.01),
+        serial="none",
+        sessions=2,
+        env={"KBT_CLASS_COMPRESS": "1"},
+    )
+    class_columns(u400kc)
+    assert u400kc["solver"].startswith("class_"), (
+        f"uniform 400k classes row solved on {u400kc['solver']!r} — the "
+        "compressed layer never engaged, the row is not evidence"
+    )
+    assert binds_by_row["uniform_pool_400k_40k_classes"] == binds_by_row["uniform_pool_400k_40k"], (
+        "compressed uniform 400k placements diverge from the "
+        "uncompressed column"
+    )
+    u400kc["placements_equal_uncompressed"] = True
+    u400kc["class_solve_speedup_vs_uncompressed"] = round(
+        u400k["solve_s"] / u400kc["solve_s"], 2
+    )
+    # The >=5x solve-phase claim holds in the node-axis-dominated regime
+    # — the XLA while-loop twin, whose per-iteration cost grows with the
+    # node axis (measured ~linear on CPU hosts; see README). When the
+    # uncompressed column solved on the fused Pallas rung instead
+    # (TPU backends — per-iteration sequential-step latency dominates
+    # and is ~flat in node count, README "Multi-chip"), the ratio
+    # compares different kernels and is recorded info-only.
+    if u400k["solver"] == "xla":
+        assert u400kc["class_solve_speedup_vs_uncompressed"] >= 5.0, (
+            f"high-duplication 400k row: compressed solve only "
+            f"{u400kc['class_solve_speedup_vs_uncompressed']}x faster "
+            f"than the uncompressed XLA twin (claimed >=5x)"
+        )
+
+    p400kc = record(
+        "preempt_400k_40k_classes",
+        lambda: preempt_mix(400_000, 40_000),
+        serial="none",
+        sessions=2,
+        env={"KBT_CLASS_COMPRESS": "1"},
+    )
+    class_columns(p400kc)
+    assert p400kc["solver"].startswith("class_"), (
+        f"preempt 400k classes row solved on {p400kc['solver']!r} — the "
+        "compressed layer never engaged, the row is not evidence"
+    )
+    assert binds_by_row["preempt_400k_40k_classes"] == binds_by_row["preempt_400k_40k"], (
+        "compressed preempt 400k placements diverge from the "
+        "uncompressed column"
+    )
+    p400kc["placements_equal_uncompressed"] = True
+
+    # Zero warm recompiles under 1% node churn: every measured session
+    # re-rolls the churned residents' requests (a fresh churn_salt), so
+    # the class partition changes between sessions while the sticky
+    # power-of-two slot bucket holds the compiled shapes — any recompile
+    # inside the measured repeats raises via the CompileSentinel budget
+    # (the class twin of the preempt_50k_5k compile-budget pin).
+    churn_salt = iter(range(1, 100))
+    record(
+        "uniform_pool_400k_40k_classes_churn",
+        lambda: uniform_pool(
+            400_000, 40_000, churn=0.01, churn_salt=next(churn_salt)
+        ),
+        serial="none",
+        sessions=2,
+        env={"KBT_CLASS_COMPRESS": "1"},
+        compile_budget=0,
+    )
+    class_columns(details["uniform_pool_400k_40k_classes_churn"])
 
     # Incremental encode cache: warm/cold/1%-churn encode split with
     # byte-parity asserted in-row (ISSUE 5).

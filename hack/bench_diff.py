@@ -63,6 +63,17 @@ grow, ``storm_goodput_pods_per_s`` when it shrinks; ``storm_shed_*``
 counts are ``[info]`` (shed volume is a policy outcome of offered
 load, pinned by the row's own ``ok`` bit rather than diffed).
 
+Node-class compression columns (ISSUE 20) split the same way:
+``compression_ratio`` (valid nodes per node class on the compressed
+solve) regresses when it SHRINKS — a workload row whose duplication
+collapsed means the class key picked up an accidental splitter and the
+solve cost silently reverted toward per-node scaling. ``class_count``
+and the solve-cost split (``class_group_s`` host regroup vs
+``class_kernel_s`` device solve, plus ``class_splits``) are ``[info]``:
+they describe where the time went, and gating them would let a row
+"pass" by shifting cost between phases while p50 — which still gates
+on its own — tells the truth.
+
 ``--json`` emits one machine-readable summary line; ``--strict`` exits
 nonzero when any finding fired (default exit is 0 — informational).
 """
@@ -82,6 +93,7 @@ _LATENCY_KEYS = ("p50_s", "xla_s")
 _PARITY_KEYS = (
     "placements_equal_serial",
     "placements_equal_full_cycle",
+    "placements_equal_uncompressed",
     "p50_within_lease_window",
     "exactly_once",
     "union_parity",
@@ -107,11 +119,16 @@ _WIRE_HIGHER = ("binds_per_s",)
 # own ``ok`` bit, not diffed across rounds.
 _STORM_LOWER = ("storm_high_p99_s", "storm_mttr_s")
 _STORM_HIGHER = ("storm_goodput_pods_per_s",)
+# node-class compression (ISSUE 20): ratio shrink = the class key lost
+# its duplication and the solve is drifting back to per-node cost;
+# class_count / class_group_s / class_kernel_s / class_splits are the
+# [info] solve-cost split (see module docstring).
+_CLASS_HIGHER = ("compression_ratio",)
 
 
 def _is_info_key(key: str) -> bool:
     return (key in _INFO_KEYS or key.startswith("fleet_")
-            or key.startswith("storm_shed_"))
+            or key.startswith("storm_shed_") or key.startswith("class_"))
 
 
 def _is_wire_lower(key: str) -> bool:
@@ -121,7 +138,7 @@ def _is_wire_lower(key: str) -> bool:
 
 def _is_wire_higher(key: str) -> bool:
     return (key in _WIRE_HIGHER or key in _STORM_HIGHER
-            or key.startswith("txn_batch"))
+            or key in _CLASS_HIGHER or key.startswith("txn_batch"))
 
 
 def _rows_from_obj(obj):

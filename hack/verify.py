@@ -72,7 +72,12 @@ Runs, in order:
     the fleet-SLO brownout ladder armed the protected lane must hold
     its tail SLO with zero shed while the unprotected OFF twin
     collapses, and every shed decision must carry Retry-After
-    guidance.
+    guidance;
+13. the node-class compression smoke (python -m
+    kube_batch_tpu.ops.class_solve --json): serial, uncompressed and
+    KBT_CLASS_COMPRESS=1 schedules of a seeded pooled fleet must bind
+    pod-for-pod identically across two cycles, with in-solve splits
+    and second-cycle re-merges both exercised.
 
 With ``--bench-diff OLD NEW``, two bench artifacts (fresh bench.py
 output or archived BENCH_*.json wrappers) are regression-gated via
@@ -1129,6 +1134,42 @@ def main(argv: list[str] | None = None) -> int:
     if not adm_ok:
         print(res.stdout, res.stderr, sep="\n")
         print("verify: admission smoke FAILED")
+        failed = True
+
+    # 7c-quinquies. node-class compressed solve smoke (python -m
+    # kube_batch_tpu.ops.class_solve --json): the same seeded world
+    # scheduled serial / uncompressed / KBT_CLASS_COMPRESS=1 must bind
+    # pod-for-pod identically across two cycles (the second re-using
+    # the class table with binds applied, so splits and re-merges both
+    # fire), with the compressed tier actually engaged. Part of the
+    # default gate set; shell overrides must not skew either half.
+    env_cls = dict(env)
+    for var in ("KBT_CLASS_COMPRESS", "KBT_MESH", "KBT_MESH_PALLAS"):
+        env_cls.pop(var, None)
+    res = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.ops.class_solve", "--json"],
+        cwd=REPO, env=env_cls, capture_output=True, text=True,
+    )
+    cls_summary: dict = {}
+    try:
+        cls_summary = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pass
+    cls_ok = (
+        res.returncode == 0
+        and cls_summary.get("ok", False)
+        and cls_summary.get("parity_cycle1", False)
+        and cls_summary.get("parity_cycle2", False)
+    )
+    gates["class_solve_smoke"] = {
+        "ok": cls_ok,
+        "class_count": cls_summary.get("class_count"),
+        "compression_ratio": cls_summary.get("compression_ratio"),
+        "splits": cls_summary.get("splits"),
+    }
+    if not cls_ok:
+        print(res.stdout, res.stderr, sep="\n")
+        print("verify: class-solve parity smoke FAILED")
         failed = True
 
     # 7d. --federation: the wire-path smoke + the seeded two-scheduler

@@ -129,6 +129,12 @@ class XlaAllocateAction(Action):
         # batched mesh exchanges (KBT_EXCHANGE_BATCH; 0 off the batched
         # program). Bench rows read this as amortization evidence.
         self.last_batched_iters = 0
+        # Stats dict from the last class-compressed solve (ops/class_solve,
+        # KBT_CLASS_COMPRESS): class_count, compression_ratio, splits,
+        # remerges, group_s/kernel_s solve-cost split. None when the
+        # compression was off or degraded for the cycle; bench rows read
+        # this as the compression-honesty evidence.
+        self.last_class_stats = None
         # Whether the last FULL-cycle encode saw any pod-affinity terms
         # (pending or resident). Streaming micro-cycles pass this as the
         # resident_interpod hint so the encode skips the O(resident-pods)
@@ -148,6 +154,7 @@ class XlaAllocateAction(Action):
         self.last_timings = {}  # never report a previous cycle's path
         self.last_solver_tier = "none"
         self.last_batched_iters = 0
+        self.last_class_stats = None
         if not _kernel_supported(ssn):
             log.info("conf outside kernel envelope; running serial allocate")
             self._fallback(ssn)
@@ -594,6 +601,23 @@ class XlaAllocateAction(Action):
         # program is a cross-device copy jit would have to insert).
         xla_arrays = dev_arrays if (dev_arrays is not None and mesh is None) else arrays
 
+        def _wrap(fn):
+            """Node-class compressed layer (ops/class_solve,
+            KBT_CLASS_COMPRESS): runs feasibility+score+argmax at class
+            granularity over whichever rung was picked, expanding back
+            to node-space SolveState at every segment boundary. Inside
+            the budget gate — a compressed segment is still a solver
+            entry — and any class-table failure degrades to ``fn``
+            within the call, so the rung ladder below is unchanged."""
+            from kube_batch_tpu.ops import class_solve
+
+            if not class_solve.enabled():
+                return fn
+            return class_solve.wrap_solver(
+                self, fn, arrays, enable_drf, enable_proportion, dtype,
+                mesh=mesh,
+            )
+
         def _with_budget(fn):
             """Solver-entry budget gate: a device solve is the cycle's
             dominant cost, so a hard budget already gone must abort
@@ -741,13 +765,13 @@ class XlaAllocateAction(Action):
                             mp = None
                     return solve_sharded(st)
 
-                return _with_budget(solve_mesh_pallas)
+                return _with_budget(_wrap(solve_mesh_pallas))
             if xla_sharded is not None:
                 log.info(
                     "solving with node-axis-sharded XLA kernel over a "
                     "%d-device mesh", mesh.devices.size,
                 )
-                return _with_budget(solve_sharded)
+                return _with_budget(_wrap(solve_sharded))
 
         mode = os.environ.get("KBT_PALLAS", "1")
         solver = None
@@ -789,7 +813,7 @@ class XlaAllocateAction(Action):
                     solver = None
             return _xla_solve(st)
 
-        return _with_budget(solve_fn)
+        return _with_budget(_wrap(solve_fn))
 
     # -- host-side serial step for one pod-affinity task ---------------------
 

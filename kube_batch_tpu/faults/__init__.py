@@ -79,6 +79,7 @@ POINTS = (
     "solve.pallas",     # pallas compile/solve raises -> XLA twin
     "solve.xla",        # XLA twin solve raises -> serial for the cycle
     "solve.nan",        # NaN poisons a score tensor -> finite guard -> serial
+    "solve.class_table",  # poisoned/stale class table -> uncompressed solve, loud
     # cache write side (cache/cache.py)
     "bind.write",       # binder write rejected -> retry w/ jitter -> errTasks
     "bind.slow",        # slow binder (50ms stall per attempt)

@@ -584,6 +584,26 @@ arena_hbm_watermark = Gauge(
     "since process start (the bench's HBM column)",
 )
 
+# -- node-class compressed solve (ops/class_solve, KBT_CLASS_COMPRESS) -------
+class_solve_classes = Gauge(
+    f"{_SUBSYSTEM}_class_solve_classes",
+    "Node equivalence classes at the last compressed solve's entry "
+    "(the axis the solver actually scanned, padding excluded)",
+)
+class_solve_compression_ratio = Gauge(
+    f"{_SUBSYSTEM}_class_solve_compression_ratio",
+    "Valid nodes per valid node class at the last compressed solve's "
+    "entry — the node-axis shrink factor; a sustained fall toward 1.0 "
+    "means the fleet's shapes have diverged and compression is buying "
+    "nothing",
+)
+class_table_splits = Counter(
+    f"{_SUBSYSTEM}_class_table_splits_total",
+    "Class-table member movements: in-solve bind splits (a chosen node "
+    "leaves its class as a singleton) plus static re-keys from node "
+    "churn (encode-cache dirty nodes re-hashed into new classes)",
+)
+
 
 def update_e2e_duration(seconds: float) -> None:
     e2e_scheduling_latency.observe(seconds)
@@ -847,6 +867,18 @@ def set_arena_hbm_watermark(nbytes: float) -> None:
     arena_hbm_watermark.set(nbytes)
 
 
+def set_class_solve_classes(n: int) -> None:
+    class_solve_classes.set(n)
+
+
+def set_class_solve_compression_ratio(ratio: float) -> None:
+    class_solve_compression_ratio.set(ratio)
+
+
+def register_class_table_splits(n: int) -> None:
+    class_table_splits.inc(by=n)
+
+
 def set_pipeline_overlap_fraction(fraction: float) -> None:
     pipeline_overlap_fraction.set(fraction)
 
@@ -1003,6 +1035,9 @@ def render_prometheus_text() -> str:
         admission_controller_ticks,
         arena_hbm_bytes,
         arena_hbm_watermark,
+        class_solve_classes,
+        class_solve_compression_ratio,
+        class_table_splits,
     ]
     lines: list[str] = []
     for metric in families:

@@ -241,6 +241,60 @@ def preempt_contended(
     return build_cluster(pods, nodes, pgs, [build_queue("default")])
 
 
+def uniform_pool(
+    n_pods: int = 400_000, n_nodes: int = 40_000, tasks_per_job: int = 250,
+    churn: float = 0.0, churn_salt: int = 0,
+) -> ClusterInfo:
+    """Config 7: the node-class compression headline (ISSUE 20) — an
+    interchangeable-fleet pool with pod-slice-sized gangs (250 tasks,
+    the large-training shape this scheduler targets). Every node is
+    byte-identical to the encoder (same shape, no labels, no residents)
+    and the gangs cycle through two request shapes, so the solver's
+    node axis folds to a handful of equivalence classes and the
+    compressed solve cost is bounded by class count, not fleet size.
+
+    ``churn > 0`` plants a RUNNING resident on every ``1/churn``-th node
+    with one of 64 request shapes picked from ``churn_salt`` — the ~1%
+    of a real fleet that differs from the pool at any moment. Varying
+    the salt session to session moves WHICH nodes differ (and the exact
+    class count) without moving the class axis' power-of-two bucket,
+    which is what the bench's zero-recompile churn row measures."""
+    nodes = _uniform_nodes(n_nodes)
+    pods, pgs = [], []
+    if churn > 0.0:
+        step = max(int(1.0 / churn), 1)
+        for i in range(0, n_nodes, step):
+            v = (i * 31 + churn_salt * 7919) % 64
+            pods.append(
+                build_pod(
+                    name=f"churn-{churn_salt:03d}-{i:05d}",
+                    node_name=f"node-{i:05d}",
+                    phase=PodPhase.RUNNING,
+                    req=build_resource_list(
+                        cpu=f"{100 + 25 * (v % 8)}m",
+                        memory=f"{256 + 64 * (v // 8)}Mi",
+                    ),
+                )
+            )
+    n_jobs = max(n_pods // tasks_per_job, 1)
+    for j in range(n_jobs):
+        name = f"job-{j:05d}"
+        pgs.append(build_pod_group(name, min_member=max(tasks_per_job // 2, 1)))
+        small = j % 2 == 0
+        for t in range(tasks_per_job):
+            pods.append(
+                build_pod(
+                    name=f"{name}-t{t}",
+                    group_name=name,
+                    req=build_resource_list(
+                        cpu="250m" if small else "500m",
+                        memory="512Mi" if small else "1024Mi",
+                    ),
+                )
+            )
+    return build_cluster(pods, nodes, pgs, [build_queue("default")])
+
+
 def besteffort_mix(
     n_pods: int = 2000, n_nodes: int = 1000, seed: int = 0
 ) -> ClusterInfo:
@@ -294,4 +348,5 @@ CONFIGS = {
     "preempt_50k_5k": lambda: preempt_mix(50_000, 5000),
     "multi_tenant_ml": lambda: multi_tenant_ml(),
     "besteffort_2k_1k": lambda: besteffort_mix(2000, 1000),
+    "uniform_pool_50k_5k": lambda: uniform_pool(50_000, 5000),
 }

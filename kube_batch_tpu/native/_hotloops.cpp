@@ -1111,6 +1111,35 @@ done:
 
 /* ---- class_dedup --------------------------------------------------------- */
 
+/* Shared hash pass over T rows of row_bytes each at base (C-contiguous
+ * concatenated key matrix): classes numbered in FIRST-OCCURRENCE order.
+ * Returns PyTuple (first int64 bytes, inverse int32 bytes) or nullptr. */
+static PyObject* dedup_pass(const char* base, Py_ssize_t T,
+                            Py_ssize_t row_bytes) {
+  PyObject *first_b = nullptr, *inv_b = nullptr, *out = nullptr;
+  inv_b = PyBytes_FromStringAndSize(nullptr, T * (Py_ssize_t)sizeof(int32_t));
+  if (inv_b == nullptr) return nullptr;
+  int32_t* inv = (int32_t*)PyBytes_AS_STRING(inv_b);
+  std::vector<int64_t> first;
+  first.reserve(256);
+  {
+    std::unordered_map<std::string_view, int32_t> seen;
+    seen.reserve((size_t)T * 2);
+    for (Py_ssize_t i = 0; i < T; i++) {
+      std::string_view row(base + i * row_bytes, (size_t)row_bytes);
+      auto [it, inserted] = seen.emplace(row, (int32_t)first.size());
+      if (inserted) first.push_back((int64_t)i);
+      inv[i] = it->second;
+    }
+  }
+  first_b = PyBytes_FromStringAndSize((const char*)first.data(),
+                                      first.size() * sizeof(int64_t));
+  if (first_b != nullptr) out = PyTuple_Pack(2, first_b, inv_b);
+  Py_XDECREF(first_b);
+  Py_DECREF(inv_b);
+  return out;
+}
+
 /* class_dedup(keys) -> (first_bytes, inverse_bytes)
  *
  * Row-dedup of a C-contiguous 2-D buffer (any fixed-size dtype): one
@@ -1120,43 +1149,74 @@ done:
  * the difference is ~0.3 s at 400k tasks. Returns two bytes objects the
  * caller np.frombuffer's: first (int64 row index per class) and inverse
  * (int32 class id per row). Any consistent (first, inverse) pairing is
- * valid for the kernel packing; class order itself carries no meaning. */
+ * valid for the kernel packing; class order itself carries no meaning.
+ *
+ * Arbitrary-width keys: a tuple/list of 2-D buffers sharing shape[0]
+ * dedups over their per-row byte concatenation — the class-solve node
+ * key spans several dtype-mixed slabs (ops/class_solve.dedup_rows), and
+ * concatenating them byte-wise here (one scratch fill, no numpy
+ * round-trip) keeps the multi-slab form one O(N * key_bytes) pass. */
 PyObject* class_dedup(PyObject*, PyObject* arg) {
+  if (PyTuple_Check(arg) || PyList_Check(arg)) {
+    Py_ssize_t nbuf = PySequence_Fast_GET_SIZE(arg);
+    if (nbuf == 0) {
+      PyErr_SetString(PyExc_TypeError,
+                      "class_dedup needs at least one 2-D buffer");
+      return nullptr;
+    }
+    std::vector<Py_buffer> views((size_t)nbuf);
+    Py_ssize_t got = 0;
+    PyObject* out = nullptr;
+    Py_ssize_t T = 0, row_bytes = 0;
+    for (; got < nbuf; got++) {
+      PyObject* item = PySequence_Fast_GET_ITEM(arg, got);
+      if (PyObject_GetBuffer(item, &views[got],
+                             PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+        goto multi_done;
+      if (views[got].ndim != 2) {
+        PyErr_SetString(PyExc_TypeError,
+                        "class_dedup needs 2-D buffers in the key tuple");
+        got++;
+        goto multi_done;
+      }
+      if (got == 0) {
+        T = views[0].shape[0];
+      } else if (views[got].shape[0] != T) {
+        PyErr_SetString(PyExc_ValueError,
+                        "class_dedup key buffers disagree on row count");
+        got++;
+        goto multi_done;
+      }
+      row_bytes += views[got].shape[1] * views[got].itemsize;
+    }
+    {
+      /* per-row byte concat into one scratch matrix, then the same pass */
+      std::vector<char> scratch((size_t)(T * row_bytes));
+      Py_ssize_t col = 0;
+      for (Py_ssize_t b = 0; b < nbuf; b++) {
+        Py_ssize_t seg = views[b].shape[1] * views[b].itemsize;
+        const char* src = (const char*)views[b].buf;
+        char* dst = scratch.data() + col;
+        for (Py_ssize_t i = 0; i < T; i++)
+          std::memcpy(dst + i * row_bytes, src + i * seg, (size_t)seg);
+        col += seg;
+      }
+      out = dedup_pass(scratch.data(), T, row_bytes);
+    }
+  multi_done:
+    for (Py_ssize_t b = 0; b < got; b++) PyBuffer_Release(&views[b]);
+    return out;
+  }
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
     return nullptr;
-  PyObject *first_b = nullptr, *inv_b = nullptr, *out = nullptr;
-  {
-    if (view.ndim != 2) {
-      PyErr_SetString(PyExc_TypeError, "class_dedup needs a 2-D buffer");
-      goto done;
-    }
-    Py_ssize_t T = view.shape[0];
-    Py_ssize_t row_bytes = view.shape[1] * view.itemsize;
-    inv_b = PyBytes_FromStringAndSize(nullptr, T * (Py_ssize_t)sizeof(int32_t));
-    if (inv_b == nullptr) goto done;
-    int32_t* inv = (int32_t*)PyBytes_AS_STRING(inv_b);
-    std::vector<int64_t> first;
-    first.reserve(256);
-    {
-      std::unordered_map<std::string_view, int32_t> seen;
-      seen.reserve((size_t)T * 2);
-      const char* base = (const char*)view.buf;
-      for (Py_ssize_t i = 0; i < T; i++) {
-        std::string_view row(base + i * row_bytes, (size_t)row_bytes);
-        auto [it, inserted] = seen.emplace(row, (int32_t)first.size());
-        if (inserted) first.push_back((int64_t)i);
-        inv[i] = it->second;
-      }
-    }
-    first_b = PyBytes_FromStringAndSize((const char*)first.data(),
-                                        first.size() * sizeof(int64_t));
-    if (first_b == nullptr) goto done;
-    out = PyTuple_Pack(2, first_b, inv_b);
+  PyObject* out = nullptr;
+  if (view.ndim != 2) {
+    PyErr_SetString(PyExc_TypeError, "class_dedup needs a 2-D buffer");
+  } else {
+    out = dedup_pass((const char*)view.buf, view.shape[0],
+                     view.shape[1] * view.itemsize);
   }
-done:
-  Py_XDECREF(first_b);
-  Py_XDECREF(inv_b);
   PyBuffer_Release(&view);
   return out;
 }
@@ -1176,7 +1236,9 @@ PyMethodDef methods[] = {
     {"extract_node_columns", extract_node_columns, METH_VARARGS,
      "Fill [A,N,R] cpu/mem columns from NodeInfo resource attributes."},
     {"class_dedup", class_dedup, METH_O,
-     "Row-dedup a 2-D buffer: (first int64 bytes, inverse int32 bytes)."},
+     "Row-dedup a 2-D buffer, or a tuple/list of 2-D buffers sharing "
+     "shape[0] (byte-concatenated per row): (first int64 bytes, "
+     "inverse int32 bytes)."},
     {"bulk_dispatch", bulk_dispatch, METH_VARARGS,
      "Move masked jobs' ALLOCATED buckets under BINDING; return the tasks."},
     {"finish_columns", finish_columns, METH_VARARGS,

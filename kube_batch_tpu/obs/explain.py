@@ -255,8 +255,20 @@ def explain_post_solve(ssn, enc, arrays, state, result, topk: int = TOP_K) -> di
 
     out: dict = {}
     if job_rows:
+        from kube_batch_tpu.ops import class_solve
+
+        # Under KBT_CLASS_COMPRESS forensics fold the node axis the same
+        # way the solver does: one evaluated row per equivalence class,
+        # expanded back to per-node records by membership. Byte-identical
+        # outputs either way (ops/explain parity test), so records never
+        # change shape when the flag flips.
+        explain_fn = (
+            ops_explain.explain_batch_classes
+            if class_solve.enabled()
+            else ops_explain.explain_batch
+        )
         rep_rows = ops_explain.pad_rows([r for _, r in job_rows])
-        elim, feasible, would, nm_idx, nm_score, nm_planes = ops_explain.explain_batch(
+        elim, feasible, would, nm_idx, nm_score, nm_planes = explain_fn(
             a,
             np.asarray(state.idle),
             np.asarray(state.rel),

@@ -210,6 +210,146 @@ def explain_batch(a, idle, rel, used, ntasks, nports, rep_rows, topk=3):
     return tuple(np.asarray(x) for x in out)
 
 
+# Node-side keys _score_planes reads per node — the class key for the
+# compressed explain path. Two nodes with identical bytes across these
+# slabs plus the five state tensors produce identical planes and scores,
+# so forensics only has to evaluate one representative per class.
+_CLASS_NODE_KEYS = (
+    "node_alloc",
+    "node_ok",
+    "node_valid",
+    "node_gid",
+    "node_max_tasks",
+    "node_idle_has_sc",
+    "node_rel_has_sc",
+)
+
+
+@jax.jit
+def _planes_scores_jit(a, idle, rel, used, ntasks, nports, rep_rows):
+    """Raw (planes [G, 4, C], score [G, C]) over class-representative
+    node rows — the same _score_planes ops as _explain_jit, so a class
+    row produces the identical bytes its member nodes would."""
+    T = a["task_req"].shape[0]
+
+    def one(t):
+        tc = jnp.clip(jnp.maximum(t, 0), 0, T - 1)
+        return _score_planes(a, idle, rel, used, ntasks, nports, tc, jnp)
+
+    return jax.vmap(one)(rep_rows)
+
+
+def explain_batch_classes(a, idle, rel, used, ntasks, nports, rep_rows, topk=3):
+    """Class-compressed forensics: byte-identical outputs to
+    ``explain_batch``, with the per-node device evaluation folded to one
+    row per node equivalence class (ops/class_solve key discipline).
+
+    The final node state is grouped over the explain-relevant key (the
+    static node slabs _score_planes reads plus the five dynamic state
+    tensors); planes and scores are evaluated on class representatives
+    only, then expanded on host: elimination / feasible counts by valid
+    member multiplicity, would-fit-if by class validity, and the top-k
+    near-miss list by replaying the node-level argmax tie contract
+    (score descending, lowest node row wins ties) from the sorted
+    member lists. Cost scales with class count, not node count."""
+    from kube_batch_tpu.ops.class_solve import _pow2, dedup_rows
+
+    idle = np.asarray(idle)
+    rel = np.asarray(rel)
+    used = np.asarray(used)
+    ntasks = np.asarray(ntasks)
+    nports = np.asarray(nports)
+    sub = {k: np.asarray(a[k]) for k in ARRAY_KEYS}
+    first, inv = dedup_rows(
+        [sub[k] for k in _CLASS_NODE_KEYS] + [idle, rel, used, ntasks, nports]
+    )
+    C = int(first.shape[0])
+    counts = np.bincount(inv, minlength=C).astype(np.int64)
+    order = np.argsort(inv, kind="stable").astype(np.int64)
+    off = np.zeros(C, np.int64)
+    np.cumsum(counts[:-1], out=off[1:])
+    rep = order[off]  # lowest member row per class (= first occurrence)
+
+    # Pad the class axis to a power-of-two bucket (index-0 repeats) so
+    # the jitted program recompiles per bucket, not per class count.
+    Cp = _pow2(C)
+    rep_p = np.concatenate([rep, np.zeros(Cp - C, np.int64)])
+    for key in _CLASS_NODE_KEYS:
+        sub[key] = sub[key][rep_p]
+    for w in ("w_least", "w_balanced", "w_aff"):
+        sub[w] = jnp.asarray(a[w], np.asarray(a["task_req"]).dtype)
+    planes_c, score_c = _planes_scores_jit(
+        sub,
+        jnp.asarray(idle[rep_p]),
+        jnp.asarray(rel[rep_p]),
+        jnp.asarray(used[rep_p]),
+        jnp.asarray(ntasks[rep_p]),
+        jnp.asarray(nports[rep_p]),
+        jnp.asarray(rep_rows, jnp.int32),
+    )
+    planes_c = np.asarray(planes_c)  # [G, 4, Cp] bool
+    score_c = np.asarray(score_c)  # [G, Cp] fdtype
+
+    valid_c = np.asarray(a["node_valid"], bool)[rep]  # class-uniform (in key)
+    vcounts = np.where(valid_c, counts, 0)
+    G = len(rep_rows)
+    k = int(topk)
+    P = len(PLANES)
+    elim = np.zeros((G, P), np.int32)
+    feasible = np.zeros(G, np.int32)
+    would = np.zeros((G, P), bool)
+    nm_idx = np.zeros((G, k), np.int32)
+    nm_score = np.zeros((G, k), score_c.dtype)
+    nm_planes = np.zeros((G, k, P), bool)
+    vcls = np.flatnonzero(valid_c)
+    for g, t in enumerate(np.asarray(rep_rows)):
+        if t < 0:
+            continue  # padding row: explain_batch carries garbage here too
+        pl = planes_c[g][:, :C]  # [4, C]
+        sc = score_c[g][:C]
+        elim[g] = (vcounts[None, :] * ~pl).sum(axis=1)
+        feasible[g] = int((vcounts * pl.all(axis=0)).sum())
+        for p in range(P):
+            relaxed = pl.copy()
+            relaxed[p] = True
+            would[g, p] = bool((valid_c & relaxed.all(axis=0)).any())
+        # Top-k replay of the node-level argmax+mask rounds. Classes
+        # sorted by (score desc, lowest member); take classes until k
+        # members are covered, then extend through the boundary score
+        # tie group — members of equal-score classes interleave by node
+        # row, so every class tied at the cut must be materialized.
+        m = 0
+        if vcls.size:
+            o = vcls[np.lexsort((rep[vcls], -sc[vcls]))]
+            taken = 0
+            i = 0
+            while i < o.size and taken < k:
+                taken += counts[o[i]]
+                i += 1
+            while i < o.size and sc[o[i]] == sc[o[i - 1]]:
+                i += 1
+            chosen = o[:i]
+            mem_nodes = np.concatenate(
+                [order[off[c] : off[c] + counts[c]] for c in chosen]
+            )
+            mem_cls = np.repeat(chosen, counts[chosen])
+            sidx = np.lexsort((mem_nodes, -sc[mem_cls]))[:k]
+            nodes, cls = mem_nodes[sidx], mem_cls[sidx]
+            m = nodes.size
+            nm_idx[g, :m] = nodes
+            nm_score[g, :m] = sc[cls]
+            nm_planes[g, :m] = pl[:, cls].T
+        if m < k:
+            # Node-level exhaustion contract: argmax over an all -inf
+            # ranking returns row 0, so the pad entry is node 0's raw
+            # score and planes, repeated.
+            c0 = inv[0]
+            nm_idx[g, m:] = 0
+            nm_score[g, m:] = sc[c0]
+            nm_planes[g, m:] = pl[:, c0]
+    return elim, feasible, would, nm_idx, nm_score, nm_planes
+
+
 def explain_rows_np(a, idle, rel, used, ntasks, nports, rep_rows, topk=3):
     """The serial twin: identical numbers, computed task by task with
     host numpy (the correctness-oracle side of explain parity)."""
